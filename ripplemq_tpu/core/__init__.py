@@ -1,0 +1,12 @@
+"""Pure data-plane core: fixed-shape log tensors and jitted Raft steps."""
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
+
+__all__ = [
+    "EngineConfig",
+    "ReplicaState",
+    "StepInput",
+    "StepOutput",
+    "init_state",
+]

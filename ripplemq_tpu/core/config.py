@@ -1,0 +1,44 @@
+"""Static engine configuration.
+
+Every field here is a *shape* as far as XLA is concerned: the whole data
+plane is traced once per EngineConfig and never recompiled. Membership
+changes, leader changes and partition starts/stops are expressed as masked
+*values* (alive masks, leader ids, counts), never as shape changes — see
+SURVEY.md §7 "hard parts".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Shape/config of one replication-engine program.
+
+    The reference runs one JRaft group per topic-partition, all multiplexed
+    on a single RPC server (reference:
+    mq-broker/src/main/java/metadata/raft/PartitionRaftServer.java:93).
+    Here the multiplexing is a tensor axis: `partitions` is the leading
+    vmap axis of every state array.
+    """
+
+    partitions: int = 8          # P — total partition slots in the program
+    replicas: int = 3            # R — replication factor == mesh axis size
+    slots: int = 1024            # S — log capacity per partition (entries)
+    slot_bytes: int = 128        # SB — payload bytes per log slot
+    max_batch: int = 32          # B — max appended entries per partition/step
+    read_batch: int = 32         # RB — max entries per batch read
+    max_consumers: int = 64      # C — consumer-offset table width
+    max_offset_updates: int = 8  # U — max offset commits per partition/step
+
+    def __post_init__(self) -> None:
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if self.max_batch > self.slots:
+            raise ValueError("max_batch cannot exceed slots")
+
+    @property
+    def quorum(self) -> int:
+        """Majority of the full membership (Raft quorum)."""
+        return self.replicas // 2 + 1

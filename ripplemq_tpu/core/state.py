@@ -1,0 +1,101 @@
+"""Replicated data-plane state as fixed-shape arrays.
+
+One `ReplicaState` is the full data-plane state of ONE replica: the slotted
+message log, Raft bookkeeping scalars and the consumer-offset table for
+every partition hosted by the program. The reference keeps the equivalent
+state as `List<String> messages` + `Map<String, Long> consumerOffsets` per
+partition group (reference:
+mq-broker/src/main/java/metadata/raft/PartitionStateMachine.java:26-27),
+purely in JVM heap; here it is a pytree of device arrays so that
+replication, quorum and apply are tensor ops.
+
+Axis conventions (see EngineConfig):
+  P = partitions, R = replicas, S = log slots, SB = slot bytes,
+  B = append batch, C = consumer table width, U = offset-update batch.
+
+Arrays never carry the replica axis here — the replica axis is added
+either by `jax.vmap(..., axis_name="replica")` (single-device simulation)
+or by sharding over a mesh axis with `shard_map` (real SPMD). The step
+functions in `core.step` are written against axis name "replica" and run
+unchanged under both.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ripplemq_tpu.core.config import EngineConfig
+
+
+class ReplicaState(NamedTuple):
+    """Per-replica data-plane state (one replica's view of P partitions)."""
+
+    log_data: jax.Array     # uint8 [P, S, SB] — slotted message payloads
+    log_len: jax.Array      # int32 [P, S]     — payload length per slot (0 = empty)
+    log_term: jax.Array     # int32 [P, S]     — Raft term that wrote each slot
+    log_end: jax.Array      # int32 [P]        — next index to append (log length)
+    current_term: jax.Array  # int32 [P]       — latest term this replica has seen
+    commit: jax.Array       # int32 [P]        — commit index (entries [0, commit) durable)
+    offsets: jax.Array      # int32 [P, C]     — replicated consumer offsets
+
+
+class StepInput(NamedTuple):
+    """One replication round's input (per partition).
+
+    Fed identically to every replica by the single controller: the
+    leader→follower AppendEntries transfer of the reference
+    (mq-broker/.../MessageAppendRequestProcessor.java:59) is realised by
+    the input's sharding layout — XLA broadcasts the batch over the
+    replica mesh axis on ICI as part of data distribution.
+    """
+
+    entries: jax.Array     # uint8 [P, B, SB] — new payloads (leader's batch)
+    lens: jax.Array        # int32 [P, B]     — payload lengths
+    counts: jax.Array      # int32 [P]        — how many of B are valid
+    off_slots: jax.Array   # int32 [P, U]     — consumer-table slots to update
+    off_vals: jax.Array    # int32 [P, U]     — new absolute offsets
+    off_counts: jax.Array  # int32 [P]        — how many of U are valid
+    leader: jax.Array      # int32 [P]        — replica id of partition leader (-1 = none)
+    term: jax.Array        # int32 [P]        — leader's term (host/election-managed)
+
+
+class StepOutput(NamedTuple):
+    """Per-partition results of one round (identical on every replica
+    after the psum — the host reads any one replica's copy)."""
+
+    base: jax.Array        # int32 [P] — leader log_end before append (first assigned offset)
+    votes: jax.Array       # int32 [P] — number of replicas that acked the round
+    committed: jax.Array   # bool  [P] — quorum reached this round
+    commit: jax.Array      # int32 [P] — post-round commit index
+
+
+def init_state(cfg: EngineConfig) -> ReplicaState:
+    """Zero state for one replica."""
+    P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
+    return ReplicaState(
+        log_data=jnp.zeros((P, S, SB), jnp.uint8),
+        log_len=jnp.zeros((P, S), jnp.int32),
+        log_term=jnp.zeros((P, S), jnp.int32),
+        log_end=jnp.zeros((P,), jnp.int32),
+        current_term=jnp.zeros((P,), jnp.int32),
+        commit=jnp.zeros((P,), jnp.int32),
+        offsets=jnp.zeros((P, C), jnp.int32),
+    )
+
+
+def empty_input(cfg: EngineConfig) -> StepInput:
+    """An all-empty round (no appends, no offset commits, no leaders)."""
+    P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+    return StepInput(
+        entries=jnp.zeros((P, B, SB), jnp.uint8),
+        lens=jnp.zeros((P, B), jnp.int32),
+        counts=jnp.zeros((P,), jnp.int32),
+        off_slots=jnp.zeros((P, U), jnp.int32),
+        off_vals=jnp.zeros((P, U), jnp.int32),
+        off_counts=jnp.zeros((P,), jnp.int32),
+        leader=jnp.full((P,), -1, jnp.int32),
+        term=jnp.zeros((P,), jnp.int32),
+    )

@@ -1,0 +1,289 @@
+"""Pure Raft data-plane steps, written against a named "replica" axis.
+
+This module is the TPU-native replacement for the reference's hot loop:
+JRaft AppendEntries replication + per-entry quorum ack + state-machine
+apply (reference call stack: MessageAppendRequestProcessor.java:59 →
+JRaft replication → PartitionStateMachine.onApply:38). There, each message
+is one Raft task on one of many per-partition JVM actor groups. Here, ONE
+jitted step replicates a (partition × entry) batch across every replica
+and advances every partition's commit index in a single psum round:
+
+  1. Every replica receives the round's batch (the broadcast over the
+     replica axis is the AppendEntries transfer; under SPMD it rides ICI).
+  2. A replica *acks* iff it is alive, its log end matches the leader's
+     pre-append log end (the Raft log-matching check) and the leader's
+     term is current.
+  3. Acking replicas append the batch into their slotted log.
+  4. votes = lax.psum(ack) over the replica axis; quorum ⇒ the commit
+     index advances (the majority-match rule of Raft, replacing JRaft's
+     per-entry ballot).
+  5. Committed offset updates are scattered into the replicated
+     consumer-offset table (the reference routes these through the same
+     per-partition Raft log — PartitionStateMachine.java:71-77).
+
+Rare, branchy transitions (elections, membership, resync after a replica
+returns from the dead) are host-coordinated; the per-step path is
+branch-free so XLA compiles it once per EngineConfig. Leader election's
+vote *counting* does run on device (`vote_step`) as a psum reduction.
+
+The functions take per-replica state and use collectives over the axis
+name "replica"; wrap them with `jax.vmap(..., axis_name="replica")` for a
+single-device simulation or shard the replica axis over a mesh with
+`shard_map` for real multi-chip SPMD (see ripplemq_tpu.parallel.engine).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput
+
+AXIS = "replica"
+
+
+def _bcast_from_leader(value: jax.Array, is_leader: jax.Array) -> jax.Array:
+    """Broadcast a per-replica value from each partition's leader to all
+    replicas: mask to the leader's contribution, sum over the replica axis.
+    `value`/`is_leader` are [P]-shaped per-replica arrays."""
+    contrib = jnp.where(is_leader, value, jnp.zeros_like(value))
+    return lax.psum(contrib, AXIS)
+
+
+def _append_one(
+    log_data, log_len, log_term, entries, lens, count, start, term, do_append
+):
+    """Append up to B entries at `start` into one partition's slotted log.
+
+    Reads a [B, SB] window, blends the valid prefix of the batch in,
+    writes it back. `do_append` disables the write (identity blend) for
+    replicas that did not ack. Shapes: log_data [S, SB], entries [B, SB],
+    lens [B], scalars otherwise.
+
+    dynamic_slice/update clamp the window start so the window fits; when
+    `start > S - B` (tail of the log) the window begins `shift` rows
+    before `start`, so the batch and its validity mask are rolled forward
+    by `shift` to land on the right absolute slots. The caller guarantees
+    start + count <= S, hence count <= B - shift and nothing wraps.
+    """
+    B = entries.shape[0]
+    S = log_data.shape[0]
+    sl_start = jnp.clip(start, 0, S - B)
+    shift = start - sl_start
+    valid = (jnp.arange(B, dtype=jnp.int32) < count) & do_append  # [B]
+    valid = jnp.roll(valid, shift, axis=0)
+    entries = jnp.roll(entries, shift, axis=0)
+    lens = jnp.roll(lens, shift, axis=0)
+
+    window = lax.dynamic_slice(log_data, (sl_start, 0), (B, log_data.shape[1]))
+    window = jnp.where(valid[:, None], entries, window)
+    log_data = lax.dynamic_update_slice(log_data, window, (sl_start, 0))
+
+    len_win = lax.dynamic_slice(log_len, (sl_start,), (B,))
+    len_win = jnp.where(valid, lens, len_win)
+    log_len = lax.dynamic_update_slice(log_len, len_win, (sl_start,))
+
+    term_win = lax.dynamic_slice(log_term, (sl_start,), (B,))
+    term_win = jnp.where(valid, jnp.full((B,), term, jnp.int32), term_win)
+    log_term = lax.dynamic_update_slice(log_term, term_win, (sl_start,))
+
+    return log_data, log_len, log_term
+
+
+def replica_step(
+    cfg: EngineConfig,
+    state: ReplicaState,
+    inp: StepInput,
+    rep_idx: jax.Array,   # int32 scalar — this replica's id on the axis
+    alive: jax.Array,     # bool [R]     — membership mask (replicated)
+) -> tuple[ReplicaState, StepOutput]:
+    """One replication round, from one replica's point of view."""
+    S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
+
+    # Sanitize host-fed control values: an out-of-range index is undefined
+    # behavior on TPU gathers (observed: backend InvalidArgument), and an
+    # oversized count would advance log_end past what was written
+    # (phantom committed entries).
+    counts = jnp.clip(inp.counts, 0, B)
+    inp = inp._replace(counts=counts)
+
+    self_alive = alive[rep_idx]
+    leader_known = (inp.leader >= 0) & (inp.leader < R)  # [P]
+    is_leader = (inp.leader == rep_idx) & leader_known   # [P]
+    leader_alive = jnp.where(
+        leader_known, alive[jnp.clip(inp.leader, 0, R - 1)], False
+    )
+
+    # --- 1. leader's pre-append log end ("prevLogIndex" of AppendEntries).
+    base = _bcast_from_leader(state.log_end, is_leader & self_alive)  # [P]
+
+    # --- 2. ack: alive + log-matching + term current.
+    term_ok = inp.term >= state.current_term
+    log_match = state.log_end == base
+    capacity_ok = base + inp.counts <= S  # backpressure: full partitions never ack
+    # A round is ack-worthy if it carries entries OR offset commits: offset
+    # commits on idle partitions must still replicate (the reference routes
+    # them through the partition Raft log regardless of appends).
+    has_work = (inp.counts > 0) | (inp.off_counts > 0)
+    ack = (
+        self_alive
+        & leader_alive
+        & term_ok
+        & log_match
+        & capacity_ok
+        & has_work
+    )  # [P]
+
+    # Followers adopt the leader's (host/election-issued) term.
+    new_current_term = jnp.maximum(state.current_term, inp.term)
+
+    # --- 3. append the batch on acking replicas (vmapped over partitions).
+    log_data, log_len, log_term = jax.vmap(_append_one)(
+        state.log_data,
+        state.log_len,
+        state.log_term,
+        inp.entries,
+        inp.lens,
+        inp.counts,
+        jnp.where(ack, base, 0),
+        inp.term,
+        ack,
+    )
+    new_log_end = jnp.where(ack, base + inp.counts, state.log_end)
+
+    # --- 4. quorum vote: count acks across the replica axis.
+    votes = lax.psum(ack.astype(jnp.int32), AXIS)          # [P]
+    committed = votes >= cfg.quorum                        # [P]
+
+    # A replica moves its commit index only if it holds the entries
+    # (ack), mirroring Raft's commit = min(leaderCommit, lastIndex);
+    # commit never regresses.
+    commit_target = jnp.where(committed & ack, base + inp.counts, 0)
+    new_commit = jnp.maximum(state.commit, commit_target)
+
+    # --- 5. committed consumer-offset updates (scatter into the table).
+    # The reference replicates offset commits through the same partition
+    # Raft log (ConsumerOffsetUpdateRequestProcessor.java:38-69 →
+    # PartitionStateMachine.java:71-77); here they ride the same quorum
+    # round as the data batch.
+    U = cfg.max_offset_updates
+    off_counts = jnp.clip(inp.off_counts, 0, U)
+    off_valid = (jnp.arange(U, dtype=jnp.int32)[None, :] < off_counts[:, None])
+    off_apply = off_valid & (committed & ack)[:, None]      # [P, U]
+    C = cfg.max_consumers
+    scatter_idx = jnp.where(off_apply, inp.off_slots, C)    # C = out of range → dropped
+
+    def _scatter_offsets(offs, idx, vals):
+        return offs.at[idx].set(vals, mode="drop")
+
+    new_offsets = jax.vmap(_scatter_offsets)(state.offsets, scatter_idx, inp.off_vals)
+
+    new_state = ReplicaState(
+        log_data=log_data,
+        log_len=log_len,
+        log_term=log_term,
+        log_end=new_log_end,
+        current_term=new_current_term,
+        commit=new_commit,
+        offsets=new_offsets,
+    )
+    out = StepOutput(
+        base=base,
+        votes=votes,
+        committed=committed,
+        commit=lax.pmax(new_commit, AXIS),
+    )
+    return new_state, out
+
+
+def vote_step(
+    cfg: EngineConfig,
+    state: ReplicaState,
+    cand: jax.Array,       # int32 [P] — candidate replica id per partition (-1 = no election)
+    cand_term: jax.Array,  # int32 [P] — candidate's proposed term
+    rep_idx: jax.Array,
+    alive: jax.Array,
+) -> tuple[ReplicaState, jax.Array, jax.Array]:
+    """One RequestVote round: grants counted as a psum reduction.
+
+    Returns (state', elected[P] bool, votes[P] int32). The up-to-date
+    check is Raft §5.4.1: grant only to candidates whose log is at least
+    as complete. Replaces JRaft's per-group ballot
+    (NodeOptions.setElectionTimeoutMs — reference
+    PartitionRaftServer.java:85 — with timeouts host-vectorized).
+    """
+    R = cfg.replicas
+    electing = (cand >= 0) & (cand < R)
+    is_cand = (cand == rep_idx) & electing
+    self_alive = alive[rep_idx]
+    cand_alive = jnp.where(electing, alive[jnp.clip(cand, 0, R - 1)], False)
+
+    last_idx = jnp.maximum(state.log_end - 1, 0)
+    my_last_term = jnp.where(
+        state.log_end > 0,
+        jnp.take_along_axis(state.log_term, last_idx[:, None], axis=1)[:, 0],
+        0,
+    )
+    c_end = _bcast_from_leader(state.log_end, is_cand & self_alive)
+    c_last_term = _bcast_from_leader(my_last_term, is_cand & self_alive)
+
+    up_to_date = (c_last_term > my_last_term) | (
+        (c_last_term == my_last_term) & (c_end >= state.log_end)
+    )
+    grant = electing & self_alive & cand_alive & (cand_term > state.current_term) & up_to_date
+
+    votes = lax.psum(grant.astype(jnp.int32), AXIS)
+    elected = votes >= cfg.quorum
+
+    new_term = jnp.where(grant, cand_term, state.current_term)
+    return state._replace(current_term=new_term), elected, votes
+
+
+def read_batch(
+    cfg: EngineConfig,
+    state: ReplicaState,
+    partition: jax.Array,  # int32 scalar
+    offset: jax.Array,     # int32 scalar — absolute offset to read from
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Read up to RB *committed* entries of one partition from this replica.
+
+    Returns (data [RB, SB] uint8, lens [RB] int32, count int32). Serves
+    the consume path; like the reference this is a replica-local read with
+    no extra consensus round (PartitionStateMachine.handleBatchRead:85 —
+    leader-local, no read-index), but unlike the reference it only exposes
+    entries below the commit index.
+    """
+    RB = cfg.read_batch
+    partition = jnp.clip(partition, 0, cfg.partitions - 1)
+    commit = state.commit[partition]
+    start = jnp.clip(offset, 0, cfg.slots)
+    count = jnp.clip(commit - start, 0, RB)
+    # dynamic_slice clamps the start so the window fits; compensate by
+    # slicing at a clamped start and rolling the wanted rows to the front
+    # (count never exceeds RB - shift, so rolled-in garbage is masked out).
+    sl_start = jnp.clip(start, 0, cfg.slots - RB)
+    shift = start - sl_start
+    data = lax.dynamic_slice(
+        state.log_data,
+        (partition, sl_start, 0),
+        (1, RB, cfg.slot_bytes),
+    )[0]
+    lens = lax.dynamic_slice(state.log_len, (partition, sl_start), (1, RB))[0]
+    data = jnp.roll(data, -shift, axis=0)
+    lens = jnp.roll(lens, -shift, axis=0)
+    valid = jnp.arange(RB, dtype=jnp.int32) < count
+    return jnp.where(valid[:, None], data, 0), jnp.where(valid, lens, 0), count
+
+
+def read_offset(
+    state: ReplicaState,
+    partition: jax.Array,
+    consumer_slot: jax.Array,
+) -> jax.Array:
+    """Current committed offset for one consumer slot."""
+    P, C = state.offsets.shape
+    return state.offsets[
+        jnp.clip(partition, 0, P - 1), jnp.clip(consumer_slot, 0, C - 1)
+    ]

@@ -1,0 +1,13 @@
+"""Mesh construction and SPMD execution of the core replication steps."""
+
+from ripplemq_tpu.parallel.mesh import make_mesh, pick_axes
+from ripplemq_tpu.parallel.engine import LocalEngineFns, SpmdEngineFns, make_local_fns, make_spmd_fns
+
+__all__ = [
+    "make_mesh",
+    "pick_axes",
+    "LocalEngineFns",
+    "SpmdEngineFns",
+    "make_local_fns",
+    "make_spmd_fns",
+]

@@ -1,0 +1,307 @@
+"""Compiled engine entry points: local (vmap) and SPMD (shard_map) modes.
+
+The core steps in `ripplemq_tpu.core.step` are written once against the
+axis name "replica". This module binds them two ways:
+
+- **local**: `jax.vmap(..., axis_name="replica")` stacks all replicas on a
+  leading axis of a single device's arrays. Used for single-chip
+  deployments and deterministic tests — the replication round is then a
+  pure function: same tensors in → same commit index out (SURVEY.md §4).
+
+- **spmd**: `shard_map` over a (replica, part) `Mesh` — one device per
+  replica × partition-shard; psums ride ICI/DCN. This is the multi-chip
+  production path.
+
+Both produce bit-identical semantics (asserted in tests/test_spmd.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
+from ripplemq_tpu.core import step as core_step
+
+try:  # jax>=0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+class LocalEngineFns(NamedTuple):
+    init: Callable[[], ReplicaState]          # -> state with leading [R] axis
+    step: Callable[..., tuple[ReplicaState, StepOutput]]
+    vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
+    read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
+    read_offset: Callable[..., jax.Array]
+    resync: Callable[..., ReplicaState]
+
+
+class SpmdEngineFns(NamedTuple):
+    init: Callable[[], ReplicaState]
+    step: Callable[..., tuple[ReplicaState, StepOutput]]
+    vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
+    read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
+    read_offset: Callable[..., jax.Array]
+    resync: Callable[..., ReplicaState]
+    mesh: Mesh
+
+
+# ---------------------------------------------------------------------------
+# Resync (shared): copy one healthy replica's rows into a recovering replica.
+# ---------------------------------------------------------------------------
+
+def _resync(cfg: EngineConfig, state: ReplicaState, src: jax.Array,
+            dst: jax.Array, part_mask: jax.Array) -> ReplicaState:
+    """Overwrite replica `dst`'s state for masked partitions with replica
+    `src`'s (the leader's) state. State here carries an explicit leading
+    replica axis [R, ...]. This is the snapshot-install analogue: the
+    reference inherits full log replay from JRaft and has no FSM snapshots
+    (SURVEY.md §5 checkpoint); here recovery is one on-device copy.
+    """
+    R = cfg.replicas
+
+    def copy_leaf(leaf):
+        src_rows = leaf[src]                       # [P, ...]
+        mask = part_mask.reshape((1, -1) + (1,) * (leaf.ndim - 2))
+        is_dst = (jnp.arange(R) == dst).reshape((R,) + (1,) * (leaf.ndim - 1))
+        return jnp.where(is_dst & mask, src_rows[None], leaf)
+
+    return jax.tree.map(copy_leaf, state)
+
+
+# ---------------------------------------------------------------------------
+# Local (single device, replicas vmapped)
+# ---------------------------------------------------------------------------
+
+def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
+    R = cfg.replicas
+    rep_idx = jnp.arange(R, dtype=jnp.int32)
+
+    @jax.jit
+    def _init():
+        one = init_state(cfg)
+        return jax.tree.map(lambda x: jnp.broadcast_to(x, (R,) + x.shape).copy(), one)
+
+    vstep = jax.vmap(
+        functools.partial(core_step.replica_step, cfg),
+        in_axes=(0, None, 0, None),
+        axis_name=core_step.AXIS,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step(state, inp: StepInput, alive):
+        new_state, out = vstep(state, inp, rep_idx, alive)
+        # outputs are replica-invariant after the psum; take replica 0's copy
+        return new_state, jax.tree.map(lambda x: x[0], out)
+
+    vvote = jax.vmap(
+        functools.partial(core_step.vote_step, cfg),
+        in_axes=(0, None, None, 0, None),
+        axis_name=core_step.AXIS,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _vote(state, cand, cand_term, alive):
+        new_state, elected, votes = vvote(state, cand, cand_term, rep_idx, alive)
+        return new_state, elected[0], votes[0]
+
+    @jax.jit
+    def _read(state, replica, partition, offset):
+        replica = jnp.clip(replica, 0, R - 1)
+        one = jax.tree.map(lambda x: x[replica], state)
+        return core_step.read_batch(cfg, one, partition, offset)
+
+    @jax.jit
+    def _read_offset(state, replica, partition, consumer_slot):
+        replica = jnp.clip(replica, 0, R - 1)
+        one = jax.tree.map(lambda x: x[replica], state)
+        return core_step.read_offset(one, partition, consumer_slot)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _resync_fn(state, src, dst, part_mask):
+        return _resync(cfg, state, src, dst, part_mask)
+
+    return LocalEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn)
+
+
+# ---------------------------------------------------------------------------
+# SPMD (mesh: replica × part)
+# ---------------------------------------------------------------------------
+
+def _state_specs(cfg: EngineConfig) -> ReplicaState:
+    """PartitionSpecs for the full-cluster state [R, P, ...]: replica axis
+    over "replica", partition axis over "part"."""
+    return ReplicaState(
+        log_data=P("replica", "part", None, None),
+        log_len=P("replica", "part", None),
+        log_term=P("replica", "part", None),
+        log_end=P("replica", "part"),
+        current_term=P("replica", "part"),
+        commit=P("replica", "part"),
+        offsets=P("replica", "part", None),
+    )
+
+
+def _input_specs() -> StepInput:
+    """Inputs carry no replica axis: XLA's data distribution replicates
+    them over the replica mesh axis (this IS the AppendEntries fan-out)."""
+    return StepInput(
+        entries=P("part", None, None),
+        lens=P("part", None),
+        counts=P("part"),
+        off_slots=P("part", None),
+        off_vals=P("part", None),
+        off_counts=P("part"),
+        leader=P("part"),
+        term=P("part"),
+    )
+
+
+def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
+    R = cfg.replicas
+    part_shards = mesh.shape["part"]
+    if mesh.shape["replica"] != R:
+        raise ValueError(
+            f"mesh replica axis {mesh.shape['replica']} != cfg.replicas {R}"
+        )
+    if cfg.partitions % part_shards:
+        raise ValueError("partitions must divide evenly over the part axis")
+    local_P = cfg.partitions // part_shards
+
+    st_specs = _state_specs(cfg)
+    in_specs = _input_specs()
+    rep_ids = jnp.arange(R, dtype=jnp.int32)
+
+    def _squeeze(tree):
+        return jax.tree.map(lambda x: x[0], tree)
+
+    def _expand(tree):
+        return jax.tree.map(lambda x: x[None], tree)
+
+    # ---- step -------------------------------------------------------------
+    def step_body(state, inp, rep, alive):
+        st = _squeeze(state)          # strip the size-1 replica block dim
+        new_st, out = core_step.replica_step(cfg, st, inp, rep[0], alive)
+        return _expand(new_st), out   # out is psum-replicated over "replica"
+
+    smapped_step = _shard_map(
+        step_body,
+        mesh=mesh,
+        in_specs=(st_specs, in_specs, P("replica"), P()),
+        out_specs=(st_specs, StepOutput(P("part"), P("part"), P("part"), P("part"))),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step(state, inp, alive):
+        return smapped_step(state, inp, rep_ids, alive)
+
+    # ---- vote -------------------------------------------------------------
+    def vote_body(state, cand, cand_term, rep, alive):
+        st = _squeeze(state)
+        new_st, elected, votes = core_step.vote_step(
+            cfg, st, cand, cand_term, rep[0], alive
+        )
+        return _expand(new_st), elected, votes
+
+    smapped_vote = _shard_map(
+        vote_body,
+        mesh=mesh,
+        in_specs=(st_specs, P("part"), P("part"), P("replica"), P()),
+        out_specs=(st_specs, P("part"), P("part")),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _vote(state, cand, cand_term, alive):
+        return smapped_vote(state, cand, cand_term, rep_ids, alive)
+
+    # ---- read (broadcast the serving replica's window to every device) ----
+    def read_body(state, rep, replica, partition, offset):
+        st = _squeeze(state)
+        my_rep = rep[0]
+        # global partition -> (shard, local index); shards are contiguous
+        shard = partition // local_P
+        local_idx = partition % local_P
+        my_shard = jax.lax.axis_index("part")
+        data, lens, count = core_step.read_batch(cfg, st, local_idx, offset)
+        sel = (my_rep == replica) & (my_shard == shard)
+        zero = jnp.int32(0)
+        data = jax.lax.psum(jnp.where(sel, data, 0), ("replica", "part"))
+        lens = jax.lax.psum(jnp.where(sel, lens, 0), ("replica", "part"))
+        count = jax.lax.psum(jnp.where(sel, count, zero), ("replica", "part"))
+        return data, lens, count
+
+    smapped_read = _shard_map(
+        read_body,
+        mesh=mesh,
+        in_specs=(st_specs, P("replica"), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+    )
+
+    @jax.jit
+    def _read(state, replica, partition, offset):
+        replica = jnp.clip(replica, 0, R - 1)
+        partition = jnp.clip(partition, 0, cfg.partitions - 1)
+        return smapped_read(state, rep_ids, replica, partition, offset)
+
+    def read_off_body(state, rep, replica, partition, consumer_slot):
+        st = _squeeze(state)
+        shard = partition // local_P
+        local_idx = partition % local_P
+        sel = (rep[0] == replica) & (jax.lax.axis_index("part") == shard)
+        val = core_step.read_offset(st, local_idx, consumer_slot)
+        return jax.lax.psum(jnp.where(sel, val, 0), ("replica", "part"))
+
+    smapped_read_off = _shard_map(
+        read_off_body,
+        mesh=mesh,
+        in_specs=(st_specs, P("replica"), P(), P(), P()),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def _read_offset(state, replica, partition, consumer_slot):
+        replica = jnp.clip(replica, 0, R - 1)
+        partition = jnp.clip(partition, 0, cfg.partitions - 1)
+        return smapped_read_off(state, rep_ids, replica, partition, consumer_slot)
+
+    # ---- resync -----------------------------------------------------------
+    def resync_body(state, rep, src, dst, part_mask):
+        st = _squeeze(state)
+        my_rep = rep[0]
+        # broadcast src replica's masked rows to everyone, then overwrite dst
+        def leaf(x):
+            m = part_mask.reshape((-1,) + (1,) * (x.ndim - 1))
+            src_rows = jax.lax.psum(
+                jnp.where((my_rep == src) & m, x, jnp.zeros_like(x)), "replica"
+            )
+            return jnp.where((my_rep == dst) & m, src_rows, x)
+
+        return _expand(jax.tree.map(leaf, st))
+
+    smapped_resync = _shard_map(
+        resync_body,
+        mesh=mesh,
+        in_specs=(st_specs, P("replica"), P(), P(), P("part")),
+        out_specs=st_specs,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _resync_fn(state, src, dst, part_mask):
+        return smapped_resync(state, rep_ids, src, dst, part_mask)
+
+    # ---- init -------------------------------------------------------------
+    def _init():
+        one = init_state(cfg)
+        full = jax.tree.map(lambda x: jnp.broadcast_to(x, (R,) + x.shape), one)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), st_specs,
+                                 is_leaf=lambda s: isinstance(s, P))
+        return jax.tree.map(jax.device_put, full, shardings)
+
+    return SpmdEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn, mesh)

@@ -1,0 +1,60 @@
+"""Device-mesh construction for the replication engine.
+
+Two mesh axes:
+
+- ``"replica"`` — the replication factor. One device per replica; quorum
+  votes are psums over this axis, and the AppendEntries broadcast rides it
+  (ICI within a host, DCN across hosts via jax.distributed). Replaces the
+  reference's broker-to-broker Bolt RPC fan-out
+  (mq-broker/.../TopicsRaftServer.java:106, BrokerRpcClient.java).
+
+- ``"part"`` — partition sharding. Partitions are data-parallel:
+  independent logs, no cross-partition collectives, so this axis only
+  shards the leading P axis of the state (the reference's "many Raft
+  groups multiplexed on one server", PartitionRaftServer.java:93, becomes
+  a sharded tensor axis).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def pick_axes(n_devices: int, replicas: int | None = None) -> tuple[int, int]:
+    """Choose (replica, part) axis sizes for n devices.
+
+    An explicitly requested replication factor must divide the device
+    count — silently degrading RF would weaken quorum durability without
+    warning. With no request, pick the largest of (5, 3, 2, 1) that
+    divides; remaining devices shard partitions.
+    """
+    if replicas is not None:
+        if n_devices % replicas:
+            raise ValueError(
+                f"replication factor {replicas} does not divide {n_devices} "
+                f"devices; refusing to silently weaken the quorum"
+            )
+        return replicas, n_devices // replicas
+    for r in (5, 3, 2, 1):
+        if n_devices % r == 0:
+            return r, n_devices // r
+    return 1, n_devices
+
+
+def make_mesh(
+    replicas: int,
+    part_shards: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a (replica, part) mesh over the given (or all) devices."""
+    devices = devices if devices is not None else jax.devices()
+    need = replicas * part_shards
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for mesh (replica={replicas}, part={part_shards}), "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(replicas, part_shards)
+    return Mesh(grid, axis_names=("replica", "part"))

@@ -1,0 +1,22 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax's
+backend initializes.
+
+The environment pins JAX_PLATFORMS=axon (the real TPU tunnel), and the
+axon site hook re-asserts it, so the env var alone is not enough —
+`jax.config.update` after import wins. Multi-chip behavior (replica mesh
+axis, partition sharding, psum quorum) is exercised on the virtual CPU
+mesh; real-TPU runs happen only in bench.py.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

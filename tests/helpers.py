@@ -1,0 +1,80 @@
+"""Shared test utilities: build StepInputs from plain Python values."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.state import StepInput
+
+
+def small_cfg(**kw) -> EngineConfig:
+    base = dict(
+        partitions=4,
+        replicas=3,
+        slots=64,
+        slot_bytes=32,
+        max_batch=8,
+        read_batch=8,
+        max_consumers=8,
+        max_offset_updates=4,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def make_input(
+    cfg: EngineConfig,
+    appends: dict[int, list[bytes]] | None = None,
+    offset_updates: dict[int, list[tuple[int, int]]] | None = None,
+    leader: dict[int, int] | int = 0,
+    term: int = 1,
+) -> StepInput:
+    """Build a StepInput. `appends` maps partition -> payload list;
+    `offset_updates` maps partition -> [(consumer_slot, offset)];
+    `leader` is a per-partition dict or a single replica id for all."""
+    P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+    entries = np.zeros((P, B, SB), np.uint8)
+    lens = np.zeros((P, B), np.int32)
+    counts = np.zeros((P,), np.int32)
+    off_slots = np.zeros((P, U), np.int32)
+    off_vals = np.zeros((P, U), np.int32)
+    off_counts = np.zeros((P,), np.int32)
+
+    for p, msgs in (appends or {}).items():
+        assert len(msgs) <= B
+        for i, m in enumerate(msgs):
+            assert len(m) <= SB
+            entries[p, i, : len(m)] = np.frombuffer(m, np.uint8)
+            lens[p, i] = len(m)
+        counts[p] = len(msgs)
+
+    for p, ups in (offset_updates or {}).items():
+        assert len(ups) <= U
+        for i, (slot, off) in enumerate(ups):
+            off_slots[p, i] = slot
+            off_vals[p, i] = off
+        off_counts[p] = len(ups)
+
+    if isinstance(leader, dict):
+        lead = np.full((P,), -1, np.int32)
+        for p, r in leader.items():
+            lead[p] = r
+    else:
+        lead = np.full((P,), leader, np.int32)
+
+    return StepInput(
+        entries=entries,
+        lens=lens,
+        counts=counts,
+        off_slots=off_slots,
+        off_vals=off_vals,
+        off_counts=off_counts,
+        leader=lead,
+        term=np.full((P,), term, np.int32),
+    )
+
+
+def decode_read(data, lens, count) -> list[bytes]:
+    data, lens, count = np.asarray(data), np.asarray(lens), int(count)
+    return [bytes(data[i, : lens[i]].tobytes()) for i in range(count)]

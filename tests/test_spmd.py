@@ -1,0 +1,120 @@
+"""SPMD (shard_map over replica × part mesh) vs local (vmap) equivalence.
+
+The same core step code runs under both bindings; on the 8-device virtual
+CPU platform we assert bit-identical state evolution. This validates the
+multi-chip sharding without TPU hardware (SURVEY.md §7 scale-out).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
+from ripplemq_tpu.parallel.mesh import make_mesh, pick_axes
+from tests.helpers import small_cfg, make_input, decode_read
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _scenario(cfg):
+    """A few rounds exercising commits, minorities, offsets, multi-leader."""
+    R = cfg.replicas
+    alive_all = np.ones((R,), bool)
+    alive_partial = alive_all.copy()
+    alive_partial[-1] = False
+    return [
+        (make_input(cfg, appends={0: [b"r0-a", b"r0-b"], 3: [b"p3"]}), alive_all),
+        (make_input(cfg, appends={1: [b"x"]}, leader={1: R - 1, 0: 0}), alive_all),
+        (make_input(cfg, appends={0: [b"c"]}, offset_updates={0: [(2, 2)]}), alive_partial),
+        (make_input(cfg, appends={2: [b"only-leader"]}), alive_partial),
+    ]
+
+
+@pytest.mark.parametrize("replicas,part_shards", [(2, 4), (4, 2), (2, 1), (8, 1)])
+def test_spmd_matches_local(replicas, part_shards):
+    cfg = small_cfg(replicas=replicas, partitions=8)
+    mesh = make_mesh(replicas, part_shards)
+    local = make_local_fns(cfg)
+    spmd = make_spmd_fns(cfg, mesh)
+
+    ls, ss = local.init(), spmd.init()
+    for inp, alive in _scenario(cfg):
+        ls, lout = local.step(ls, inp, alive)
+        ss, sout = spmd.step(ss, inp, alive)
+        for a, b in zip(jax.tree.leaves(lout), jax.tree.leaves(sout)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ls), jax.tree.leaves(ss)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # reads agree (partition 0 lives on shard 0, partition 7 on the last)
+    for part in (0, 7):
+        ld = local.read(ls, 0, part, 0)
+        sd = spmd.read(ss, 0, part, 0)
+        for a, b in zip(jax.tree.leaves(ld), jax.tree.leaves(sd)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(local.read_offset(ls, 0, 0, 2)) == int(spmd.read_offset(ss, 0, 0, 2))
+
+
+def test_spmd_vote_and_resync():
+    cfg = small_cfg(replicas=2, partitions=8)
+    mesh = make_mesh(2, 4)
+    spmd = make_spmd_fns(cfg, mesh)
+    st = spmd.init()
+
+    # replica 1 dead; entries commit on majority-of-2? quorum(2)=2 -> no
+    st, out = spmd.step(
+        st, make_input(cfg, appends={0: [b"a"]}), np.array([True, False])
+    )
+    assert not bool(out.committed[0])
+
+    # full quorum commits
+    st, out = spmd.step(st, make_input(cfg, appends={5: [b"b"]}), np.ones(2, bool))
+    assert bool(out.committed[5])
+
+    # vote: replica 1 runs for partition 5 with a fresh term
+    cand = np.full((8,), -1, np.int32)
+    cand[5] = 1
+    st, elected, votes = spmd.vote(
+        st, cand, np.full((8,), 3, np.int32), np.ones(2, bool)
+    )
+    assert bool(elected[5]) and int(votes[5]) == 2
+
+    # resync partition 0 (leader appended uncommitted entry) then commit
+    mask = np.zeros((8,), bool)
+    mask[0] = True
+    st = spmd.resync(st, jnp.int32(0), jnp.int32(1), mask)
+    st, out = spmd.step(st, make_input(cfg, appends={0: [b"c"]}), np.ones(2, bool))
+    assert bool(out.committed[0])
+    data, lens, count = spmd.read(st, 1, 0, 0)
+    assert decode_read(data, lens, count) == [b"a", b"c"]
+
+
+def test_pick_axes():
+    from ripplemq_tpu.parallel.mesh import pick_axes
+
+    assert pick_axes(8, 2) == (2, 4)
+    assert pick_axes(8) == (2, 4)
+    assert pick_axes(15) == (5, 3)
+    assert pick_axes(6, 3) == (3, 2)
+    assert pick_axes(7) == (1, 7)  # prime, no preferred factor -> all part
+    with pytest.raises(ValueError):
+        pick_axes(8, 3)  # never silently weaken a requested RF
+
+
+def test_spmd_read_out_of_range_matches_local():
+    cfg = small_cfg(replicas=2, partitions=8)
+    local = make_local_fns(cfg)
+    spmd = make_spmd_fns(cfg, make_mesh(2, 4))
+    ls, ss = local.init(), spmd.init()
+    inp = make_input(cfg, appends={0: [b"a"]})
+    alive = np.ones(2, bool)
+    ls, _ = local.step(ls, inp, alive)
+    ss, _ = spmd.step(ss, inp, alive)
+    for replica, part in [(99, 0), (0, 99), (-1, 0)]:
+        lres = local.read(ls, replica, part, 0)
+        sres = spmd.read(ss, replica, part, 0)
+        for a, b in zip(jax.tree.leaves(lres), jax.tree.leaves(sres)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
