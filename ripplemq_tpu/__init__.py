@@ -38,6 +38,8 @@ from ripplemq_tpu.core import (  # noqa: E402
     ReplicaState,
     StepInput,
     StepOutput,
+    build_step_input,
+    decode_entries,
     init_state,
 )
 
@@ -46,5 +48,7 @@ __all__ = [
     "ReplicaState",
     "StepInput",
     "StepOutput",
+    "build_step_input",
+    "decode_entries",
     "init_state",
 ]

@@ -1,6 +1,7 @@
 """Pure data-plane core: fixed-shape log tensors and jitted Raft steps."""
 
 from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.encode import build_step_input, decode_entries
 from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
 
 __all__ = [
@@ -9,4 +10,6 @@ __all__ = [
     "StepInput",
     "StepOutput",
     "init_state",
+    "build_step_input",
+    "decode_entries",
 ]

@@ -37,6 +37,8 @@ class EngineConfig:
             raise ValueError("replicas must be >= 1")
         if self.max_batch > self.slots:
             raise ValueError("max_batch cannot exceed slots")
+        if self.read_batch > self.slots:
+            raise ValueError("read_batch cannot exceed slots")
 
     @property
     def quorum(self) -> int:

@@ -1,0 +1,94 @@
+"""Host-side encoding between Python payloads and fixed-shape step tensors.
+
+This is the boundary where variable-length byte-string messages become
+slotted fixed-shape arrays (SURVEY.md §7 "hard parts" #1): payloads are
+padded into `[B, SB]` uint8 slots with a length vector, counts clamp the
+valid prefix. The broker batcher and the test suite share these builders
+so there is exactly one encoder (the reference's equivalent boundary is
+Java serialization of `List<String>` request DTOs,
+mq-common/src/main/java/request/partition/MessageAppendRequest.java).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.state import StepInput
+
+
+def build_step_input(
+    cfg: EngineConfig,
+    appends: dict[int, list[bytes]] | None = None,
+    offset_updates: dict[int, list[tuple[int, int]]] | None = None,
+    leader: dict[int, int] | int = -1,
+    term: dict[int, int] | int = 0,
+) -> StepInput:
+    """Build one round's StepInput from plain Python values.
+
+    `appends` maps partition -> payload list (each <= cfg.slot_bytes,
+    at most cfg.max_batch per partition); `offset_updates` maps
+    partition -> [(consumer_slot, absolute_offset)]; `leader`/`term` are
+    per-partition dicts or one value for all partitions. Raises ValueError
+    on oversized payloads or batches — the batcher enforces these limits
+    before building, so a trip here is a bug, not backpressure.
+    """
+    P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+    entries = np.zeros((P, B, SB), np.uint8)
+    lens = np.zeros((P, B), np.int32)
+    counts = np.zeros((P,), np.int32)
+    off_slots = np.zeros((P, U), np.int32)
+    off_vals = np.zeros((P, U), np.int32)
+    off_counts = np.zeros((P,), np.int32)
+
+    for p, msgs in (appends or {}).items():
+        if not 0 <= p < P:
+            raise ValueError(f"partition {p} out of range [0, {P})")
+        if len(msgs) > B:
+            raise ValueError(f"partition {p}: {len(msgs)} appends > max_batch {B}")
+        for i, m in enumerate(msgs):
+            if len(m) > SB:
+                raise ValueError(
+                    f"partition {p}: payload of {len(m)} bytes > slot_bytes {SB}"
+                )
+            entries[p, i, : len(m)] = np.frombuffer(m, np.uint8)
+            lens[p, i] = len(m)
+        counts[p] = len(msgs)
+
+    for p, ups in (offset_updates or {}).items():
+        if not 0 <= p < P:
+            raise ValueError(f"partition {p} out of range [0, {P})")
+        if len(ups) > U:
+            raise ValueError(
+                f"partition {p}: {len(ups)} offset updates > max_offset_updates {U}"
+            )
+        for i, (slot, off) in enumerate(ups):
+            off_slots[p, i] = slot
+            off_vals[p, i] = off
+        off_counts[p] = len(ups)
+
+    def _per_partition(value, default):
+        arr = np.full((P,), default, np.int32)
+        if isinstance(value, dict):
+            for p, v in value.items():
+                arr[p] = v
+        else:
+            arr[:] = value
+        return arr
+
+    return StepInput(
+        entries=entries,
+        lens=lens,
+        counts=counts,
+        off_slots=off_slots,
+        off_vals=off_vals,
+        off_counts=off_counts,
+        leader=_per_partition(leader, -1),
+        term=_per_partition(term, 0),
+    )
+
+
+def decode_entries(data, lens, count) -> list[bytes]:
+    """Inverse of the slot encoding for a batch read's (data, lens, count)."""
+    data, lens, count = np.asarray(data), np.asarray(lens), int(count)
+    return [bytes(data[i, : lens[i]].tobytes()) for i in range(count)]

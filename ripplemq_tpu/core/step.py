@@ -116,12 +116,27 @@ def replica_step(
         leader_known, alive[jnp.clip(inp.leader, 0, R - 1)], False
     )
 
-    # --- 1. leader's pre-append log end ("prevLogIndex" of AppendEntries).
+    # --- 1. leader's pre-append log end ("prevLogIndex" of AppendEntries)
+    # and the term of its last entry ("prevLogTerm").
     base = _bcast_from_leader(state.log_end, is_leader & self_alive)  # [P]
+    last_idx = jnp.maximum(state.log_end - 1, 0)
+    my_last_term = jnp.where(
+        state.log_end > 0,
+        jnp.take_along_axis(state.log_term, last_idx[:, None], axis=1)[:, 0],
+        0,
+    )
+    leader_last_term = _bcast_from_leader(my_last_term, is_leader & self_alive)
 
-    # --- 2. ack: alive + log-matching + term current.
+    # --- 2. ack: alive + log-matching + term current. Log matching is the
+    # full Raft check — prevLogIndex (log_end == base) AND prevLogTerm:
+    # a replica whose log is the same length but whose tail entry was
+    # written under a different term has a divergent uncommitted suffix
+    # and must NOT ack (it re-enters via host-driven resync). Length alone
+    # would let divergent committed data survive below the commit index.
     term_ok = inp.term >= state.current_term
-    log_match = state.log_end == base
+    log_match = (state.log_end == base) & (
+        (base == 0) | (my_last_term == leader_last_term)
+    )
     capacity_ok = base + inp.counts <= S  # backpressure: full partitions never ack
     # A round is ack-worthy if it carries entries OR offset commits: offset
     # commits on idle partitions must still replicate (the reference routes

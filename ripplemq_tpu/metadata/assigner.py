@@ -1,0 +1,91 @@
+"""Sticky, least-loaded replica placement — the cluster's only scheduler.
+
+Pure-function re-design of the reference's PartitionAssigner (reference:
+mq-broker/src/main/java/metadata/PartitionAssigner.java:25-115), preserving
+its semantics:
+
+- **Sticky**: replicas of an existing assignment that are still alive are
+  kept (`:61-67`); dead ones are dropped.
+- **Top-up**: each partition is topped up to its topic's replication
+  factor with the least-loaded live broker that does not already hold the
+  partition (`:81-89`, `:103-115`). Load = number of partition replicas a
+  broker holds across the whole new assignment.
+- **Leader retention**: a previous leader that survives in the replica set
+  stays leader; otherwise the leader becomes unknown until the partition
+  group elects and advertises one (the reference clears it the same way
+  through its re-election fixpoint).
+- **Error on infeasible RF**: replication factor greater than the live
+  broker count raises (`:46-48`).
+
+Determinism note: ties in "least-loaded" are broken by broker id so the
+same inputs always produce the same assignment — the reference inherits
+whatever order its HashMap iteration yields; determinism is required here
+because every broker recomputes assignments and the metadata Raft only
+converges if the leader's proposal is reproducible in tests.
+"""
+
+from __future__ import annotations
+
+from ripplemq_tpu.metadata.models import PartitionAssignment, Topic
+
+
+def assign_partitions(
+    topics: list[Topic],
+    live_brokers: list[int],
+    previous: list[Topic] | None = None,
+) -> list[Topic]:
+    """Compute a full new assignment for every topic.
+
+    `previous` carries the existing assignments (for stickiness); pass
+    None on first boot. Returns new Topic values; never mutates inputs.
+    """
+    live = sorted(set(live_brokers))
+    if not live:
+        raise ValueError("no live brokers to assign partitions to")
+
+    prev_by_name = {t.name: t for t in (previous or [])}
+    load: dict[int, int] = {b: 0 for b in live}
+
+    # Pass 1: survivors — count retained replicas into the load table first
+    # so top-up decisions see the true load (the reference builds load the
+    # same way, PartitionAssigner.java:50-67).
+    survivors: dict[tuple[str, int], list[int]] = {}
+    prev_leaders: dict[tuple[str, int], int | None] = {}
+    for topic in topics:
+        if topic.replication_factor > len(live):
+            raise ValueError(
+                f"topic {topic.name!r}: replication factor "
+                f"{topic.replication_factor} exceeds live broker count {len(live)}"
+            )
+        prev_topic = prev_by_name.get(topic.name)
+        prev_assigns = (
+            {a.partition_id: a for a in prev_topic.assignments} if prev_topic else {}
+        )
+        for pid in range(topic.partitions):
+            prev_assign = prev_assigns.get(pid)
+            kept = [b for b in (prev_assign.replicas if prev_assign else ()) if b in load]
+            kept = kept[: topic.replication_factor]
+            for b in kept:
+                load[b] += 1
+            survivors[(topic.name, pid)] = kept
+            prev_leaders[(topic.name, pid)] = prev_assign.leader if prev_assign else None
+
+    # Pass 2: top up each partition to RF with the least-loaded live broker
+    # not already holding it (ties → lowest broker id).
+    out: list[Topic] = []
+    for topic in topics:
+        assignments: list[PartitionAssignment] = []
+        for pid in range(topic.partitions):
+            replicas = list(survivors[(topic.name, pid)])
+            while len(replicas) < topic.replication_factor:
+                candidates = [b for b in live if b not in replicas]
+                pick = min(candidates, key=lambda b: (load[b], b))
+                replicas.append(pick)
+                load[pick] += 1
+            prev_leader = prev_leaders[(topic.name, pid)]
+            leader = prev_leader if prev_leader in replicas else None
+            assignments.append(
+                PartitionAssignment(pid, tuple(replicas), leader)
+            )
+        out.append(topic.with_assignments(tuple(assignments)))
+    return out

@@ -1,0 +1,107 @@
+"""Cluster configuration: YAML → immutable config value.
+
+Same role as the reference's SnakeYAML singleton loader (reference:
+mq-broker/src/main/java/config/ClusterConfigManager.java:47-63,
+ClusterConfig.java:11-120): the full static broker roster plus the static
+topic list. Deviations: no mutable singleton (the config is a value passed
+down explicitly), and engine shape parameters (slots, slot bytes, batch
+sizes) are configurable here because in the TPU design they are compile
+-time shapes (see ripplemq_tpu.core.config.EngineConfig).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import yaml
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.metadata.models import BrokerInfo, Topic
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    brokers: tuple[BrokerInfo, ...]
+    topics: tuple[Topic, ...]
+    # Engine shapes (data-plane program; one program per cluster).
+    engine: EngineConfig = EngineConfig()
+    # Timings, in seconds. Defaults mirror the reference's constants where
+    # one exists (election: PartitionRaftServer.java:85 / TopicsRaftServer
+    # .java:131; membership poll: TopicsRaftServer.java:216; client
+    # metadata refresh: ProducerClientImpl.java:18).
+    election_timeout_s: float = 1.0
+    metadata_election_timeout_s: float = 3.0
+    membership_poll_s: float = 10.0
+    metadata_refresh_s: float = 10.0
+    rpc_timeout_s: float = 3.0
+
+    def broker(self, broker_id: int) -> BrokerInfo:
+        for b in self.brokers:
+            if b.broker_id == broker_id:
+                return b
+        raise KeyError(f"unknown broker id {broker_id}")
+
+    def broker_ids(self) -> list[int]:
+        return [b.broker_id for b in self.brokers]
+
+
+def _topic_from_yaml(d: dict) -> Topic:
+    return Topic(
+        name=str(d["name"]),
+        partitions=int(d.get("partitions", 1)),
+        replication_factor=int(
+            d.get("replication_factor", d.get("replicationFactor", 1))
+        ),
+    )
+
+
+def load_cluster_config(path: str) -> ClusterConfig:
+    """Load a cluster config YAML.
+
+    Accepts both this framework's schema and the reference's field names
+    (`hostname`/`replicationFactor` — mq-broker/config/cluster_config.yaml)
+    so existing cluster files carry over.
+    """
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return parse_cluster_config(raw)
+
+
+def parse_cluster_config(raw: dict) -> ClusterConfig:
+    brokers = tuple(
+        BrokerInfo(
+            broker_id=int(b["id"] if "id" in b else b["broker_id"]),
+            host=str(b.get("host", b.get("hostname", "localhost"))),
+            port=int(b["port"]),
+        )
+        for b in raw.get("brokers", [])
+    )
+    topics = tuple(_topic_from_yaml(t) for t in raw.get("topics", []))
+    engine_raw = dict(raw.get("engine", {}))
+    total_parts = sum(t.partitions for t in topics)
+    max_rf = max([t.replication_factor for t in topics], default=1)
+    if "partitions" not in engine_raw:
+        # The program's partition axis must hold every configured partition.
+        engine_raw["partitions"] = max(1, total_parts)
+    if "replicas" not in engine_raw:
+        engine_raw["replicas"] = max_rf
+    engine = EngineConfig(**engine_raw)
+    if engine.partitions < total_parts:
+        raise ValueError(
+            f"engine.partitions={engine.partitions} cannot hold the "
+            f"{total_parts} partitions configured across topics"
+        )
+    if engine.replicas < max_rf:
+        raise ValueError(
+            f"engine.replicas={engine.replicas} is below the largest topic "
+            f"replication factor {max_rf}"
+        )
+    timing_keys = (
+        "election_timeout_s",
+        "metadata_election_timeout_s",
+        "membership_poll_s",
+        "metadata_refresh_s",
+        "rpc_timeout_s",
+    )
+    timings = {k: float(raw[k]) for k in timing_keys if k in raw}
+    return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **timings)

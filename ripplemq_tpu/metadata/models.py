@@ -1,0 +1,133 @@
+"""Immutable metadata models shared by brokers and clients.
+
+Mirrors the capability of the reference's serializable model classes
+(reference: mq-common/src/main/java/metadata/model/Topic.java:10-69,
+PartitionAssignment.java:13-16) with two deliberate deviations:
+
+- Brokers are identified by integer ids everywhere; network addresses are
+  resolved through `BrokerInfo`, never parsed out of hostnames (fixes the
+  reference's `getPortModifiedAddress` hostname-index hack,
+  mq-common/src/main/java/client/ProducerClientImpl.java:101-107).
+- Partition groups are keyed by the `(topic, partition_id)` tuple, not a
+  `"topic-partition"` string, so topic names containing `-` work (fixes
+  mq-broker/src/main/java/metadata/PartitionManager.java:257-258).
+
+All models are frozen dataclasses with dict round-tripping for the wire.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+GroupKey = tuple[str, int]
+
+
+def group_key(topic: str, partition_id: int) -> GroupKey:
+    """Canonical identity of one topic-partition replication group."""
+    return (topic, int(partition_id))
+
+
+def group_name(key: GroupKey) -> str:
+    """Display-only name (reference group naming, PartitionManager.java:121)."""
+    return f"{key[0]}-{key[1]}"
+
+
+@dataclasses.dataclass(frozen=True)
+class BrokerInfo:
+    """One broker's identity + advertised address (reference:
+    mq-broker/src/main/java/config/ClusterConfig.java:70-119)."""
+
+    broker_id: int
+    host: str
+    port: int
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def to_dict(self) -> dict:
+        return {"broker_id": self.broker_id, "host": self.host, "port": self.port}
+
+    @staticmethod
+    def from_dict(d: dict) -> "BrokerInfo":
+        return BrokerInfo(int(d["broker_id"]), str(d["host"]), int(d["port"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionAssignment:
+    """Replica set + current leader of one partition (reference:
+    mq-common/src/main/java/metadata/model/PartitionAssignment.java:13-16).
+
+    `leader` is a broker id, or None while no leader is known — the same
+    "unset until the partition group elects and advertises" fixpoint as the
+    reference (PartitionManager.java:200-275).
+    """
+
+    partition_id: int
+    replicas: tuple[int, ...]          # broker ids, stable order
+    leader: Optional[int] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "partition_id": self.partition_id,
+            "replicas": list(self.replicas),
+            "leader": self.leader,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PartitionAssignment":
+        leader = d.get("leader")
+        return PartitionAssignment(
+            int(d["partition_id"]),
+            tuple(int(r) for r in d["replicas"]),
+            None if leader is None else int(leader),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Topic:
+    """One topic: partition count, replication factor, assignments
+    (reference: mq-common/src/main/java/metadata/model/Topic.java:10-69)."""
+
+    name: str
+    partitions: int
+    replication_factor: int
+    assignments: tuple[PartitionAssignment, ...] = ()
+
+    def assignment_for(self, partition_id: int) -> Optional[PartitionAssignment]:
+        for a in self.assignments:
+            if a.partition_id == partition_id:
+                return a
+        return None
+
+    def with_assignments(
+        self, assignments: tuple[PartitionAssignment, ...]
+    ) -> "Topic":
+        return dataclasses.replace(self, assignments=assignments)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "partitions": self.partitions,
+            "replication_factor": self.replication_factor,
+            "assignments": [a.to_dict() for a in self.assignments],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Topic":
+        return Topic(
+            str(d["name"]),
+            int(d["partitions"]),
+            int(d["replication_factor"]),
+            tuple(PartitionAssignment.from_dict(a) for a in d.get("assignments", [])),
+        )
+
+
+def topics_to_wire(topics: list[Topic] | tuple[Topic, ...]) -> list[dict]:
+    return [t.to_dict() for t in topics]
+
+
+def topics_from_wire(items: list[dict]) -> list[Topic]:
+    return [Topic.from_dict(d) for d in items]

@@ -1,10 +1,10 @@
-"""Shared test utilities: build StepInputs from plain Python values."""
+"""Shared test utilities. The real encoders live in the library
+(ripplemq_tpu.core.encode); tests reuse them rather than re-implementing."""
 
 from __future__ import annotations
 
-import numpy as np
-
 from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.encode import build_step_input, decode_entries
 from ripplemq_tpu.core.state import StepInput
 
 
@@ -30,51 +30,9 @@ def make_input(
     leader: dict[int, int] | int = 0,
     term: int = 1,
 ) -> StepInput:
-    """Build a StepInput. `appends` maps partition -> payload list;
-    `offset_updates` maps partition -> [(consumer_slot, offset)];
-    `leader` is a per-partition dict or a single replica id for all."""
-    P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
-    entries = np.zeros((P, B, SB), np.uint8)
-    lens = np.zeros((P, B), np.int32)
-    counts = np.zeros((P,), np.int32)
-    off_slots = np.zeros((P, U), np.int32)
-    off_vals = np.zeros((P, U), np.int32)
-    off_counts = np.zeros((P,), np.int32)
-
-    for p, msgs in (appends or {}).items():
-        assert len(msgs) <= B
-        for i, m in enumerate(msgs):
-            assert len(m) <= SB
-            entries[p, i, : len(m)] = np.frombuffer(m, np.uint8)
-            lens[p, i] = len(m)
-        counts[p] = len(msgs)
-
-    for p, ups in (offset_updates or {}).items():
-        assert len(ups) <= U
-        for i, (slot, off) in enumerate(ups):
-            off_slots[p, i] = slot
-            off_vals[p, i] = off
-        off_counts[p] = len(ups)
-
-    if isinstance(leader, dict):
-        lead = np.full((P,), -1, np.int32)
-        for p, r in leader.items():
-            lead[p] = r
-    else:
-        lead = np.full((P,), leader, np.int32)
-
-    return StepInput(
-        entries=entries,
-        lens=lens,
-        counts=counts,
-        off_slots=off_slots,
-        off_vals=off_vals,
-        off_counts=off_counts,
-        leader=lead,
-        term=np.full((P,), term, np.int32),
+    return build_step_input(
+        cfg, appends=appends, offset_updates=offset_updates, leader=leader, term=term
     )
 
 
-def decode_read(data, lens, count) -> list[bytes]:
-    data, lens, count = np.asarray(data), np.asarray(lens), int(count)
-    return [bytes(data[i, : lens[i]].tobytes()) for i in range(count)]
+decode_read = decode_entries

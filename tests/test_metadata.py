@@ -1,0 +1,164 @@
+"""Metadata plane: assigner properties, models, config loader.
+
+The assigner's contract mirrors the reference PartitionAssigner
+(mq-broker/src/main/java/metadata/PartitionAssigner.java:25-115): sticky,
+least-loaded top-up, error on infeasible RF. SURVEY.md §4 calls for
+property tests here — the reference had none.
+"""
+
+import random
+
+import pytest
+
+from ripplemq_tpu.metadata import (
+    BrokerInfo,
+    PartitionAssignment,
+    Topic,
+    assign_partitions,
+)
+from ripplemq_tpu.metadata.cluster_config import parse_cluster_config
+from ripplemq_tpu.metadata.models import topics_from_wire, topics_to_wire
+
+
+def mk_topics(spec):
+    return [Topic(name, parts, rf) for name, parts, rf in spec]
+
+
+def all_assignments(topics):
+    return [(t.name, a) for t in topics for a in t.assignments]
+
+
+def test_assign_satisfies_rf_and_uniqueness():
+    topics = mk_topics([("t1", 3, 3), ("t2", 5, 2)])
+    out = assign_partitions(topics, live_brokers=[0, 1, 2, 3, 4])
+    for name, a in all_assignments(out):
+        t = next(t for t in out if t.name == name)
+        assert len(a.replicas) == t.replication_factor
+        assert len(set(a.replicas)) == len(a.replicas)  # no duplicate replica
+
+
+def test_assign_balances_load():
+    topics = mk_topics([("t", 10, 3)])
+    out = assign_partitions(topics, live_brokers=list(range(5)))
+    load = {b: 0 for b in range(5)}
+    for _, a in all_assignments(out):
+        for b in a.replicas:
+            load[b] += 1
+    assert sum(load.values()) == 30
+    assert max(load.values()) - min(load.values()) <= 1
+
+
+def test_assign_deterministic():
+    topics = mk_topics([("a", 7, 3), ("b", 4, 2)])
+    r1 = assign_partitions(topics, [0, 1, 2, 3])
+    r2 = assign_partitions(topics, [3, 2, 1, 0])  # order must not matter
+    assert r1 == r2
+
+
+def test_assign_sticky_keeps_live_replicas():
+    topics = mk_topics([("t", 4, 3)])
+    first = assign_partitions(topics, [0, 1, 2, 3, 4])
+    # Kill broker 0; survivors must be retained.
+    second = assign_partitions(topics, [1, 2, 3, 4], previous=first)
+    for t_first, t_second in zip(first, second):
+        for a1, a2 in zip(t_first.assignments, t_second.assignments):
+            kept = [b for b in a1.replicas if b != 0]
+            assert all(b in a2.replicas for b in kept)
+            assert 0 not in a2.replicas
+            assert len(a2.replicas) == 3
+
+
+def test_assign_leader_retained_or_cleared():
+    topics = mk_topics([("t", 2, 3)])
+    first = assign_partitions(topics, [0, 1, 2])
+    with_leaders = [
+        t.with_assignments(
+            tuple(
+                PartitionAssignment(a.partition_id, a.replicas, a.replicas[0])
+                for a in t.assignments
+            )
+        )
+        for t in first
+    ]
+    # Leader broker stays alive → retained.
+    same = assign_partitions(topics, [0, 1, 2], previous=with_leaders)
+    for t in same:
+        for a in t.assignments:
+            assert a.leader is not None
+    # Kill every leader → cleared (unknown until re-election).
+    dead = {a.leader for t in with_leaders for a in t.assignments}
+    alive = [b for b in [0, 1, 2, 3, 4] if b not in dead]
+    healed = assign_partitions(topics, alive, previous=with_leaders)
+    for t in healed:
+        for a in t.assignments:
+            assert a.leader is None
+
+
+def test_assign_infeasible_rf_raises():
+    topics = mk_topics([("t", 1, 3)])
+    with pytest.raises(ValueError):
+        assign_partitions(topics, [0, 1])
+
+
+def test_assign_no_live_brokers_raises():
+    with pytest.raises(ValueError):
+        assign_partitions(mk_topics([("t", 1, 1)]), [])
+
+
+def test_assign_random_membership_churn_property():
+    """Whatever sequence of joins/crashes happens, every assignment stays
+    valid: RF met, all replicas live, sticky where possible."""
+    rng = random.Random(7)
+    topics = mk_topics([("x", 6, 3), ("y", 3, 2)])
+    live = {0, 1, 2, 3, 4}
+    prev = assign_partitions(topics, sorted(live))
+    for _ in range(30):
+        if len(live) > 3 and rng.random() < 0.5:
+            live.discard(rng.choice(sorted(live)))
+        else:
+            live.add(rng.randrange(10))
+        new = assign_partitions(topics, sorted(live), previous=prev)
+        for t in new:
+            for a in t.assignments:
+                assert len(a.replicas) == t.replication_factor
+                assert set(a.replicas) <= live
+                prev_t = next(p for p in prev if p.name == t.name)
+                pa = prev_t.assignment_for(a.partition_id)
+                survivors = [b for b in pa.replicas if b in live][
+                    : t.replication_factor
+                ]
+                assert all(b in a.replicas for b in survivors)
+        prev = new
+
+
+def test_models_wire_roundtrip():
+    t = Topic(
+        "orders-eu",  # dash in name must be safe (fixed reference quirk)
+        2,
+        3,
+        (
+            PartitionAssignment(0, (1, 2, 3), 2),
+            PartitionAssignment(1, (0, 1, 4), None),
+        ),
+    )
+    [back] = topics_from_wire(topics_to_wire([t]))
+    assert back == t
+
+
+def test_parse_cluster_config_both_schemas():
+    raw = {
+        "brokers": [
+            {"id": 1, "hostname": "broker1", "port": 9092},   # reference schema
+            {"broker_id": 2, "host": "b2", "port": 9093},     # native schema
+        ],
+        "topics": [
+            {"name": "topic1", "partitions": 3, "replicationFactor": 2},
+            {"name": "topic2", "partitions": 2, "replication_factor": 2},
+        ],
+    }
+    cfg = parse_cluster_config(raw)
+    assert cfg.broker(1) == BrokerInfo(1, "broker1", 9092)
+    assert cfg.broker(2).host == "b2"
+    assert cfg.engine.partitions == 5  # sum of topic partitions
+    assert cfg.engine.replicas == 2
+    assert cfg.topics[0].replication_factor == 2
