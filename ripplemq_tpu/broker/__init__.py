@@ -1,0 +1,21 @@
+"""Broker host runtime.
+
+The reference broker is a JVM process wrapping two tiers of JRaft plus an
+RPC server (reference: mq-broker/src/main/java/broker/BrokerServer.java).
+Here the broker host process owns:
+
+- `hostraft` — the metadata-plane Raft (replicated topics/assignments
+  table) between broker processes; low-rate, host-side by design
+  (SURVEY.md §7 layer 3).
+- `batcher` — coalesces produce/offset-commit requests into the
+  (partition × entry) StepInput tensor of one device round.
+- `driver` — the device-step loop thread stepping the replication engine.
+- `manager` — PartitionManager equivalent: topic→program-slot mapping,
+  leader bookkeeping, membership reconcile, assignment refresh.
+- `server` — request dispatch for the client-facing surface (the
+  reference's five processors, TopicsRaftServer.java:109-120).
+"""
+
+from ripplemq_tpu.broker.hostraft import RaftNode, RaftRunner
+
+__all__ = ["RaftNode", "RaftRunner"]

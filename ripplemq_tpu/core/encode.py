@@ -71,6 +71,8 @@ def build_step_input(
         arr = np.full((P,), default, np.int32)
         if isinstance(value, dict):
             for p, v in value.items():
+                if not 0 <= p < P:
+                    raise ValueError(f"partition {p} out of range [0, {P})")
                 arr[p] = v
         else:
             arr[:] = value

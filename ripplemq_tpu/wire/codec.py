@@ -1,0 +1,195 @@
+"""Self-describing binary codec ("rb-enc") + frame IO.
+
+Replaces the reference's double Java serialization (once at the Bolt RPC
+layer, once inside Raft log entries — reference:
+mq-broker/.../TopicsRequestProcessor.java:56-63) with a single compact
+encoding. Message payload bytes pass through verbatim — no base64, no
+string coercion.
+
+Supported values: None, bool, int (64-bit signed), float, str, bytes,
+list, dict[str, value]. Ints use a varint zig-zag; strings/bytes are
+length-prefixed.
+
+Frame format on the socket:
+    uint32 BE total length | uint64 BE request id | encoded body
+Request ids let one connection pipeline many in-flight requests and match
+responses out of order (the reference's Bolt invokeSync allows one
+outstanding request per call — SURVEY.md §3.2 lists "no client
+pipelining" among its throughput bottlenecks).
+"""
+
+from __future__ import annotations
+
+import io
+import socket
+import struct
+
+_NONE = b"n"
+_TRUE = b"t"
+_FALSE = b"f"
+_INT = b"i"
+_FLOAT = b"d"
+_STR = b"s"
+_BYTES = b"b"
+_LIST = b"l"
+_DICT = b"m"
+
+MAX_FRAME = 64 * 1024 * 1024  # hard cap against corrupt/hostile lengths
+
+
+_INT64_MIN, _INT64_MAX = -(2**63), 2**63 - 1
+
+
+def _write_varint(out: io.BytesIO, n: int) -> None:
+    # zig-zag then LEB128; the zig-zag is only correct within 64 bits, so
+    # out-of-range ints must error rather than silently corrupt.
+    if not _INT64_MIN <= n <= _INT64_MAX:
+        raise OverflowError(f"int {n} outside the codec's 64-bit range")
+    zz = (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+    while True:
+        b = zz & 0x7F
+        zz >>= 7
+        if zz:
+            out.write(bytes((b | 0x80,)))
+        else:
+            out.write(bytes((b,)))
+            return
+
+
+def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    shift = 0
+    zz = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        zz |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+    return (zz >> 1) ^ -(zz & 1), pos
+
+
+def _encode_into(out: io.BytesIO, v) -> None:
+    if v is None:
+        out.write(_NONE)
+    elif v is True:
+        out.write(_TRUE)
+    elif v is False:
+        out.write(_FALSE)
+    elif isinstance(v, int):
+        out.write(_INT)
+        _write_varint(out, v)
+    elif isinstance(v, float):
+        out.write(_FLOAT)
+        out.write(struct.pack(">d", v))
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.write(_STR)
+        _write_varint(out, len(raw))
+        out.write(raw)
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        raw = bytes(v)
+        out.write(_BYTES)
+        _write_varint(out, len(raw))
+        out.write(raw)
+    elif isinstance(v, (list, tuple)):
+        out.write(_LIST)
+        _write_varint(out, len(v))
+        for item in v:
+            _encode_into(out, item)
+    elif isinstance(v, dict):
+        out.write(_DICT)
+        _write_varint(out, len(v))
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise TypeError(f"dict keys must be str, got {type(k).__name__}")
+            raw = k.encode("utf-8")
+            _write_varint(out, len(raw))
+            out.write(raw)
+            _encode_into(out, item)
+    else:
+        raise TypeError(f"unencodable type {type(v).__name__}")
+
+
+def encode(v) -> bytes:
+    out = io.BytesIO()
+    _encode_into(out, v)
+    return out.getvalue()
+
+
+def _decode_at(buf: memoryview, pos: int):
+    tag = bytes(buf[pos : pos + 1])
+    pos += 1
+    if tag == _NONE:
+        return None, pos
+    if tag == _TRUE:
+        return True, pos
+    if tag == _FALSE:
+        return False, pos
+    if tag == _INT:
+        return _read_varint(buf, pos)
+    if tag == _FLOAT:
+        return struct.unpack(">d", buf[pos : pos + 8])[0], pos + 8
+    if tag == _STR:
+        n, pos = _read_varint(buf, pos)
+        return str(buf[pos : pos + n], "utf-8"), pos + n
+    if tag == _BYTES:
+        n, pos = _read_varint(buf, pos)
+        return bytes(buf[pos : pos + n]), pos + n
+    if tag == _LIST:
+        n, pos = _read_varint(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = _decode_at(buf, pos)
+            items.append(item)
+        return items, pos
+    if tag == _DICT:
+        n, pos = _read_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            klen, pos = _read_varint(buf, pos)
+            k = str(buf[pos : pos + klen], "utf-8")
+            pos += klen
+            d[k], pos = _decode_at(buf, pos)
+        return d, pos
+    raise ValueError(f"bad tag byte {tag!r} at {pos - 1}")
+
+
+def decode(raw: bytes | memoryview):
+    v, pos = _decode_at(memoryview(raw), 0)
+    if pos != len(raw):
+        raise ValueError(f"trailing bytes after value ({pos} != {len(raw)})")
+    return v
+
+
+# --- frame IO ---------------------------------------------------------------
+
+_HEADER = struct.Struct(">IQ")  # length (body only), request id
+
+
+def write_frame(sock: socket.socket, req_id: int, body: bytes) -> None:
+    sock.sendall(_HEADER.pack(len(body), req_id) + body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket) -> tuple[int, bytes]:
+    """Read one frame; returns (request id, body). Raises ConnectionError
+    on EOF, ValueError on an oversized length (corruption guard)."""
+    header = _read_exact(sock, _HEADER.size)
+    length, req_id = _HEADER.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame length {length} exceeds cap {MAX_FRAME}")
+    return req_id, _read_exact(sock, length)

@@ -1,0 +1,169 @@
+"""Wire codec + transport tests (in-proc faults, TCP pipelining)."""
+
+import threading
+
+import pytest
+
+from ripplemq_tpu.wire import (
+    InProcNetwork,
+    RpcError,
+    RpcTimeout,
+    TcpClient,
+    TcpServer,
+    decode,
+    encode,
+)
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        True,
+        False,
+        0,
+        -1,
+        2**62,
+        -(2**62),
+        3.75,
+        "",
+        "héllo wörld",
+        b"",
+        b"\x00\xff" * 100,
+        [],
+        [1, "two", b"three", None, [4.5]],
+        {},
+        {"type": "append", "msgs": [b"a", b"b"], "n": 2, "nested": {"x": None}},
+    ],
+)
+def test_codec_roundtrip(value):
+    assert decode(encode(value)) == value
+
+
+def test_codec_rejects_trailing_and_bad_tags():
+    with pytest.raises(ValueError):
+        decode(encode(1) + b"x")
+    with pytest.raises(ValueError):
+        decode(b"\xfe")
+    with pytest.raises(TypeError):
+        encode(object())
+    with pytest.raises(TypeError):
+        encode({1: "non-string key"})
+
+
+def test_inproc_basic_and_handler_error():
+    net = InProcNetwork()
+    net.register("b1", lambda req: {"ok": True, "echo": req["x"]})
+    net.register("boom", lambda req: 1 / 0)
+    c = net.client("c1")
+    assert c.call("b1", {"type": "t", "x": b"payload"})["echo"] == b"payload"
+    resp = c.call("boom", {"type": "t"})
+    assert resp["ok"] is False and "ZeroDivisionError" in resp["error"]
+
+
+def test_inproc_faults():
+    net = InProcNetwork()
+    net.register("b1", lambda req: {"ok": True})
+    c = net.client("c1")
+    assert c.call("b1", {"type": "t"})["ok"]
+
+    net.set_down("b1")
+    with pytest.raises(RpcError):
+        c.call("b1", {"type": "t"})
+    net.set_up("b1")
+
+    net.block("c1", "b1")
+    with pytest.raises(RpcTimeout):
+        c.call("b1", {"type": "t"})
+    net.unblock("c1", "b1")
+
+    net.drop_next("c1", "b1", 2)
+    for _ in range(2):
+        with pytest.raises(RpcTimeout):
+            c.call("b1", {"type": "t"})
+    assert c.call("b1", {"type": "t"})["ok"]
+
+    with pytest.raises(RpcError):
+        c.call("nonexistent", {"type": "t"})
+
+
+def test_tcp_roundtrip_pipelined():
+    seen = []
+
+    def handler(req):
+        seen.append(req["i"])
+        return {"ok": True, "i": req["i"], "data": req["data"]}
+
+    server = TcpServer("127.0.0.1", 0, handler)
+    server.start()
+    client = TcpClient()
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        futs = [
+            client.call_async(addr, {"type": "echo", "i": i, "data": b"x" * i})
+            for i in range(32)
+        ]
+        for i, fut in enumerate(futs):
+            resp = fut.result(timeout=5)
+            assert resp["i"] == i and resp["data"] == b"x" * i
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_tcp_handler_exception_becomes_error_response():
+    server = TcpServer("127.0.0.1", 0, lambda req: {}[req["missing"]])
+    server.start()
+    client = TcpClient()
+    try:
+        resp = client.call(f"127.0.0.1:{server.port}", {"type": "t", "missing": "k"})
+        assert resp["ok"] is False and "internal" in resp["error"]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_tcp_concurrent_callers_share_connection():
+    server = TcpServer("127.0.0.1", 0, lambda req: {"ok": True, "i": req["i"]})
+    server.start()
+    client = TcpClient()
+    errors = []
+
+    def worker(i):
+        try:
+            resp = client.call(f"127.0.0.1:{server.port}", {"type": "t", "i": i})
+            assert resp["i"] == i
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(20)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_tcp_server_stop_fails_inflight_cleanly():
+    server = TcpServer("127.0.0.1", 0, lambda req: {"ok": True})
+    server.start()
+    client = TcpClient()
+    addr = f"127.0.0.1:{server.port}"
+    assert client.call(addr, {"type": "t"})["ok"]
+    server.stop()
+    with pytest.raises(RpcError):
+        client.call(addr, {"type": "t"}, timeout=2)
+    client.close()
+
+
+def test_codec_rejects_out_of_range_ints():
+    with pytest.raises(OverflowError):
+        encode(2**63)
+    with pytest.raises(OverflowError):
+        encode(-(2**63) - 1)
+    assert decode(encode(2**63 - 1)) == 2**63 - 1
+    assert decode(encode(-(2**63))) == -(2**63)
