@@ -17,5 +17,20 @@ Here the broker host process owns:
 """
 
 from ripplemq_tpu.broker.hostraft import RaftNode, RaftRunner
+from ripplemq_tpu.broker.dataplane import (
+    DataPlane,
+    NotCommittedError,
+    PartitionFullError,
+)
+from ripplemq_tpu.broker.manager import PartitionManager
+from ripplemq_tpu.broker.server import BrokerServer
 
-__all__ = ["RaftNode", "RaftRunner"]
+__all__ = [
+    "RaftNode",
+    "RaftRunner",
+    "DataPlane",
+    "NotCommittedError",
+    "PartitionFullError",
+    "PartitionManager",
+    "BrokerServer",
+]

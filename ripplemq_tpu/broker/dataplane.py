@@ -1,0 +1,438 @@
+"""DataPlane: the device-round driver + append batcher on the controller.
+
+This is the host component that turns many small producer requests into
+few large device rounds — the exact inversion of the reference's hot
+path, where every message is its own Raft task and RPC
+(reference: mq-common/.../PartitionClient.java:39 one message per RPC;
+MessageAppendRequestProcessor.java:59 one Raft task per request). Batching
+is where the TPU wins or loses (SURVEY.md §7 "hard parts": host↔device
+overhead vs tiny appends).
+
+One DataPlane owns: the engine state (all partitions × replicas), the
+per-partition leader/term tables, the per-partition replica liveness
+mask, pending-append/offset queues, and the step thread that drains them.
+All device interaction happens on the step thread or under its lock —
+`step` donates its input state, so a concurrent read against the old
+buffer would be use-after-donate.
+
+Elections ride the same device: `elect()` batches RequestVote rounds for
+many partitions into ONE vote_step call (the reference runs an
+independent JRaft ballot per group).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.encode import decode_entries
+from ripplemq_tpu.core.state import StepInput
+from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
+from ripplemq_tpu.parallel.mesh import make_mesh
+
+
+class NotCommittedError(Exception):
+    """The round(s) carrying this request never reached quorum."""
+
+
+class PartitionFullError(NotCommittedError):
+    """The partition's log has no room for the batch (backpressure)."""
+
+
+class _Pending:
+    __slots__ = ("payloads", "future", "rounds_left")
+
+    def __init__(self, payloads: list[bytes], future: Future, rounds_left: int):
+        self.payloads = payloads
+        self.future = future
+        self.rounds_left = rounds_left
+
+
+class _PendingOffsets(_Pending):
+    pass
+
+
+class DataPlane:
+    """See module docstring.
+
+    `mode` is "local" (replicas vmapped on one device — single-chip) or
+    "spmd" (replica × part device mesh). Semantics are identical; tests
+    assert it (tests/test_spmd.py).
+    """
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        mode: str = "local",
+        mesh=None,
+        part_shards: int = 1,
+        max_retry_rounds: int = 8,
+    ) -> None:
+        self.cfg = cfg
+        if mode == "local":
+            self.fns = make_local_fns(cfg)
+        elif mode == "spmd":
+            mesh = mesh if mesh is not None else make_mesh(cfg.replicas, part_shards)
+            self.fns = make_spmd_fns(cfg, mesh)
+        else:
+            raise ValueError(f"unknown mode {mode!r}")
+        self.max_retry_rounds = max_retry_rounds
+
+        P, R = cfg.partitions, cfg.replicas
+        self._state = self.fns.init()
+        self.leader = np.full((P,), -1, np.int32)
+        self.term = np.zeros((P,), np.int32)
+        self.alive = np.ones((P, R), bool)
+        self.quorum = np.full((P,), cfg.quorum, np.int32)
+
+        self._appends: dict[int, list[_Pending]] = {}
+        self._offsets: dict[int, list[_PendingOffsets]] = {}
+        self._lock = threading.Lock()          # queues + control tables
+        self._device_lock = threading.Lock()   # every touch of self._state
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="dataplane-step"
+        )
+        # Metrics (host-side counters; see utils.metrics for the registry).
+        self.rounds = 0
+        self.committed_entries = 0
+        self.step_errors = 0
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- control
+
+    def set_leader(self, slot: int, leader_slot: int, term: int) -> None:
+        """Record partition `slot`'s leader replica-slot + term (host
+        election outcome; fed into every round's StepInput)."""
+        with self._lock:
+            self.leader[slot] = leader_slot
+            self.term[slot] = term
+
+    def set_alive(self, alive: np.ndarray) -> None:
+        """Install a new [P, R] per-partition replica liveness mask."""
+        alive = np.asarray(alive, bool)
+        if alive.shape != (self.cfg.partitions, self.cfg.replicas):
+            raise ValueError(f"alive mask must be [P, R], got {alive.shape}")
+        with self._lock:
+            self.alive = alive.copy()
+
+    def set_quorum(self, quorum: np.ndarray) -> None:
+        """Install per-partition quorum sizes (RF//2+1 per topic)."""
+        quorum = np.asarray(quorum, np.int32)
+        if quorum.shape != (self.cfg.partitions,):
+            raise ValueError(f"quorum must be [P], got {quorum.shape}")
+        with self._lock:
+            self.quorum = quorum.copy()
+
+    def log_ends(self) -> np.ndarray:
+        """Per-replica log ends [R, P] — the lag map the repair loop uses
+        to find replicas needing resync."""
+        with self._device_lock:
+            return np.asarray(self._state.log_end)
+
+    def current_terms(self) -> np.ndarray:
+        """Max observed term per partition [P] (election planners must
+        propose above this, or granted-then-unadvertised elections would
+        deadlock retries)."""
+        with self._device_lock:
+            return np.asarray(self._state.current_term).max(axis=0)
+
+    # ------------------------------------------------------------- submits
+
+    def submit_append(self, slot: int, payloads: list[bytes]) -> Future:
+        """Queue payloads for partition `slot`; future resolves to the
+        first assigned absolute offset once the round commits."""
+        fut: Future = Future()
+        cfg = self.cfg
+        if not 0 <= slot < cfg.partitions:
+            fut.set_exception(ValueError(f"partition slot {slot} out of range"))
+            return fut
+        if not payloads:
+            fut.set_exception(ValueError("empty append"))
+            return fut
+        if len(payloads) > cfg.max_batch:
+            # Callers (the broker server) split client batches to fit one
+            # round; a single submit never spans rounds.
+            fut.set_exception(
+                ValueError(
+                    f"{len(payloads)} payloads exceed max_batch {cfg.max_batch}"
+                )
+            )
+            return fut
+        for m in payloads:
+            if not isinstance(m, (bytes, bytearray, memoryview)):
+                fut.set_exception(
+                    TypeError(f"payloads must be bytes, got {type(m).__name__}")
+                )
+                return fut
+            if len(m) > cfg.slot_bytes:
+                fut.set_exception(
+                    ValueError(
+                        f"payload of {len(m)} bytes exceeds slot_bytes "
+                        f"{cfg.slot_bytes}"
+                    )
+                )
+                return fut
+        with self._lock:
+            self._appends.setdefault(slot, []).append(
+                _Pending(list(payloads), fut, self.max_retry_rounds)
+            )
+        self._work.set()
+        return fut
+
+    def submit_offsets(self, slot: int, updates: list[tuple[int, int]]) -> Future:
+        """Queue consumer-offset commits [(consumer_slot, offset)]; the
+        future resolves to True when the round commits (offset commits
+        replicate through the same quorum round as appends — the
+        reference routes them through the same partition Raft log,
+        ConsumerOffsetUpdateRequestProcessor.java:38-69)."""
+        fut: Future = Future()
+        C = self.cfg.max_consumers
+        if not 0 <= slot < self.cfg.partitions:
+            fut.set_exception(ValueError(f"partition slot {slot} out of range"))
+            return fut
+        if not updates or any(not 0 <= s < C for s, _ in updates):
+            fut.set_exception(ValueError(f"bad consumer slots in {updates}"))
+            return fut
+        with self._lock:
+            self._offsets.setdefault(slot, []).append(
+                _PendingOffsets([(int(s), int(o)) for s, o in updates], fut,
+                                self.max_retry_rounds)
+            )
+        self._work.set()
+        return fut
+
+    # --------------------------------------------------------------- reads
+
+    def read(self, slot: int, offset: int, replica: int) -> tuple[list[bytes], int]:
+        """Committed entries of `slot` from `offset` as seen by `replica`;
+        returns (messages, end_offset). Replica-local, no quorum round —
+        matching the reference's leader-local reads
+        (PartitionStateMachine.handleBatchRead:85) but bounded by the
+        commit index (stricter: never serves un-replicated entries)."""
+        with self._device_lock:
+            data, lens, count = self.fns.read(
+                self._state, np.int32(replica), np.int32(slot), np.int32(offset)
+            )
+            msgs = decode_entries(data, lens, count)
+        return msgs, offset + len(msgs)
+
+    def read_offset(self, slot: int, consumer_slot: int) -> int:
+        with self._device_lock:
+            return int(
+                self.fns.read_offset(
+                    self._state, np.int32(0), np.int32(slot), np.int32(consumer_slot)
+                )
+            )
+
+    def commit_index(self, slot: int) -> int:
+        """Max commit index across replicas (the leader's view)."""
+        with self._device_lock:
+            commit = np.asarray(self._state.commit)  # [R, P]
+        return int(commit[:, slot].max())
+
+    # ----------------------------------------------------------- elections
+
+    def elect(self, candidates: dict[int, tuple[int, int]]) -> dict[int, bool]:
+        """One batched RequestVote round. `candidates` maps partition slot
+        -> (candidate replica slot, proposed term). Returns slot -> elected.
+        Many partitions elect in a single device round."""
+        P = self.cfg.partitions
+        cand = np.full((P,), -1, np.int32)
+        cterm = np.zeros((P,), np.int32)
+        for slot, (c, t) in candidates.items():
+            cand[slot] = c
+            cterm[slot] = t
+        with self._lock:
+            alive = self.alive.copy()
+            quorum = self.quorum.copy()
+        with self._device_lock:
+            self._state, elected, votes = self.fns.vote(
+                self._state, cand, cterm, alive, quorum
+            )
+            elected = np.asarray(elected)
+        return {slot: bool(elected[slot]) for slot in candidates}
+
+    def resync(self, src_slot: int, dst_slot: int, partitions: list[int]) -> None:
+        """Copy `src_slot`'s replica state over `dst_slot` for the given
+        partitions (recovering replica catch-up)."""
+        mask = np.zeros((self.cfg.partitions,), bool)
+        mask[list(partitions)] = True
+        with self._device_lock:
+            self._state = self.fns.resync(
+                self._state, np.int32(src_slot), np.int32(dst_slot), mask
+            )
+
+    # ---------------------------------------------------------- step thread
+
+    def _drain(self) -> Optional[tuple[StepInput, dict]]:
+        """Build one round's StepInput from the queues. Returns None if idle."""
+        cfg = self.cfg
+        P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+        with self._lock:
+            if not self._appends and not self._offsets:
+                return None
+            entries = np.zeros((P, B, SB), np.uint8)
+            lens = np.zeros((P, B), np.int32)
+            counts = np.zeros((P,), np.int32)
+            off_slots = np.zeros((P, U), np.int32)
+            off_vals = np.zeros((P, U), np.int32)
+            off_counts = np.zeros((P,), np.int32)
+            # round_appends: slot -> [(pending, start, n)] taken this round
+            round_appends: dict[int, list[tuple[_Pending, int, int]]] = {}
+            round_offsets: dict[int, list[_PendingOffsets]] = {}
+
+            for slot, queue in list(self._appends.items()):
+                taken: list[tuple[_Pending, int, int]] = []
+                fill = 0
+                while queue and fill + len(queue[0].payloads) <= B:
+                    pend = queue.pop(0)
+                    n = len(pend.payloads)
+                    taken.append((pend, fill, n))
+                    for i, m in enumerate(pend.payloads):
+                        entries[slot, fill + i, : len(m)] = np.frombuffer(m, np.uint8)
+                        lens[slot, fill + i] = len(m)
+                    fill += n
+                if taken:
+                    counts[slot] = fill
+                    round_appends[slot] = taken
+                if not queue:
+                    self._appends.pop(slot, None)
+
+            for slot, queue in list(self._offsets.items()):
+                taken_off: list[_PendingOffsets] = []
+                fill = 0
+                while queue and fill + len(queue[0].payloads) <= U:
+                    pend = queue.pop(0)
+                    for i, (cslot, off) in enumerate(pend.payloads):
+                        off_slots[slot, fill + i] = cslot
+                        off_vals[slot, fill + i] = off
+                    fill += len(pend.payloads)
+                    taken_off.append(pend)
+                if taken_off:
+                    off_counts[slot] = fill
+                    round_offsets[slot] = taken_off
+                if not queue:
+                    self._offsets.pop(slot, None)
+
+            if not round_appends and not round_offsets:
+                return None
+            total_counts = counts.copy()
+            inp = StepInput(
+                entries=entries,
+                lens=lens,
+                counts=counts,
+                off_slots=off_slots,
+                off_vals=off_vals,
+                off_counts=off_counts,
+                leader=self.leader.copy(),
+                term=self.term.copy(),
+            )
+            alive = self.alive.copy()
+            quorum = self.quorum.copy()
+        return inp, {"appends": round_appends, "offsets": round_offsets,
+                     "counts": total_counts, "alive": alive, "quorum": quorum}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            ctx = None
+            try:
+                work = self._drain()
+                if work is None:
+                    self._work.clear()
+                    self._work.wait(timeout=0.5)
+                    continue
+                inp, ctx = work
+                with self._device_lock:
+                    self._state, out = self.fns.step(
+                        self._state, inp, ctx["alive"], ctx["quorum"]
+                    )
+                    base = np.asarray(out.base)
+                    committed = np.asarray(out.committed)
+                self.rounds += 1
+                self._settle(ctx, base, committed)
+            except Exception as e:  # the step thread must never die: fail
+                # this round's futures and keep serving (one bad round must
+                # not wedge the whole data plane).
+                self.step_errors += 1
+                if ctx is not None:
+                    self._fail_round(ctx, e)
+
+    def _fail_round(self, ctx, exc: Exception) -> None:
+        for taken in ctx["appends"].values():
+            for pend, _, _ in taken:
+                if not pend.future.done():
+                    pend.future.set_exception(exc)
+        for taken_off in ctx["offsets"].values():
+            for pend in taken_off:
+                if not pend.future.done():
+                    pend.future.set_exception(exc)
+
+    def _settle(self, ctx, base, committed) -> None:
+        requeue_a: list[tuple[int, _Pending]] = []
+        requeue_o: list[tuple[int, _PendingOffsets]] = []
+        for slot, taken in ctx["appends"].items():
+            if committed[slot]:
+                for pend, start, n in taken:
+                    self.committed_entries += n
+                    if not pend.future.done():
+                        pend.future.set_result(int(base[slot]) + start)
+            else:
+                # Distinguish permanent backpressure (log full) from a
+                # transient quorum outage: base is the leader's log end, so
+                # base + round size > slots means no retry can ever fit.
+                full = (
+                    base[slot] + int(ctx["counts"][slot]) > self.cfg.slots
+                    and base[slot] > 0
+                )
+                for pend, _, _ in taken:
+                    pend.rounds_left -= 1
+                    if full:
+                        pend.future.set_exception(
+                            PartitionFullError(
+                                f"partition {slot}: log full "
+                                f"({base[slot]}/{self.cfg.slots} used)"
+                            )
+                        )
+                    elif pend.rounds_left <= 0:
+                        pend.future.set_exception(
+                            NotCommittedError(
+                                f"partition {slot}: no quorum after "
+                                f"{self.max_retry_rounds} rounds"
+                            )
+                        )
+                    else:
+                        requeue_a.append((slot, pend))
+        for slot, taken_off in ctx["offsets"].items():
+            if committed[slot]:
+                for pend in taken_off:
+                    if not pend.future.done():
+                        pend.future.set_result(True)
+            else:
+                for pend in taken_off:
+                    pend.rounds_left -= 1
+                    if pend.rounds_left <= 0:
+                        pend.future.set_exception(
+                            NotCommittedError(f"partition {slot}: no quorum")
+                        )
+                    else:
+                        requeue_o.append((slot, pend))
+        if requeue_a or requeue_o:
+            with self._lock:
+                for slot, pend in reversed(requeue_a):
+                    self._appends.setdefault(slot, []).insert(0, pend)
+                for slot, pend in reversed(requeue_o):
+                    self._offsets.setdefault(slot, []).insert(0, pend)
+            self._work.set()

@@ -1,0 +1,334 @@
+"""PartitionManager: the control-plane brain on every broker.
+
+Role-for-role equivalent of the reference's PartitionManager (reference:
+mq-broker/src/main/java/metadata/PartitionManager.java), re-shaped for the
+TPU architecture:
+
+- It is the metadata Raft's STATE MACHINE: `apply()` consumes committed
+  commands (topic/assignment rewrites, leader advertisements, consumer
+  registrations) in log order on every broker — the
+  TopicsStateMachine.setTopics + handleTopicListChange pair (reference
+  TopicsStateMachine.java:49-78, PartitionManager.java:111-164).
+- Where the reference starts/stops one JRaft server per partition, here a
+  topics change only rewrites CONTROL TABLES of the always-running device
+  program: per-partition leader slot, term, replica-liveness mask and
+  quorum (partition "start/stop" is a mask flip, never a shape change —
+  SURVEY.md §7 hard parts).
+- Cluster-leader duties (run by whichever broker holds the metadata Raft
+  lease): assignment refresh on membership change
+  (handleMembershipChange, PartitionManager.java:72-109).
+- Controller duties (the broker driving the TPU mesh): batched
+  elections for leaderless partitions and lag repair (resync) — the
+  host-coordinated election design (SURVEY.md §7 layer 5).
+
+Static slot map: topics are config-defined (as in the reference — no
+runtime topic creation, SURVEY.md §5 config), so (topic, partition) →
+engine slot is a pure function of the config, identical on every broker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from ripplemq_tpu.broker.dataplane import DataPlane
+from ripplemq_tpu.metadata.assigner import assign_partitions
+from ripplemq_tpu.metadata.cluster_config import ClusterConfig
+from ripplemq_tpu.metadata.models import (
+    GroupKey,
+    PartitionAssignment,
+    Topic,
+    topics_from_wire,
+    topics_to_wire,
+)
+
+# Metadata-plane command ops (the hostraft log's vocabulary).
+OP_SET_TOPICS = "set_topics"
+OP_SET_LEADER = "set_leader"
+OP_REGISTER_CONSUMER = "register_consumer"
+
+
+def build_slot_map(config: ClusterConfig) -> dict[GroupKey, int]:
+    """Deterministic (topic, partition) → engine-slot mapping."""
+    keys = [
+        (t.name, pid) for t in config.topics for pid in range(t.partitions)
+    ]
+    keys.sort()
+    return {k: i for i, k in enumerate(keys)}
+
+
+class PartitionManager:
+    def __init__(
+        self,
+        broker_id: int,
+        config: ClusterConfig,
+        dataplane: Optional[DataPlane] = None,
+    ) -> None:
+        self.broker_id = broker_id
+        self.config = config
+        self.dataplane = dataplane
+        self.slot_map = build_slot_map(config)
+        self.lock = threading.RLock()
+
+        # Replicated state (the metadata Raft's state machine).
+        self.topics: list[Topic] = []
+        self.live: list[int] = list(config.broker_ids())
+        self.consumers: dict[str, int] = {}
+        self._applied_index = 0
+
+    # ------------------------------------------------- state machine hooks
+
+    def apply(self, index: int, cmd: dict) -> None:
+        """hostraft apply_fn: committed metadata commands, in log order."""
+        with self.lock:
+            self._applied_index = index
+            op = cmd.get("op")
+            if op == OP_SET_TOPICS:
+                self._apply_set_topics(
+                    topics_from_wire(cmd["topics"]), [int(b) for b in cmd["live"]]
+                )
+            elif op == OP_SET_LEADER:
+                self._apply_set_leader(
+                    cmd["topic"], int(cmd["partition"]),
+                    None if cmd["leader"] is None else int(cmd["leader"]),
+                    int(cmd["term"]),
+                )
+            elif op == OP_REGISTER_CONSUMER:
+                self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
+            # Unknown ops are ignored (forward compatibility).
+
+    def snapshot(self) -> dict:
+        """hostraft snapshot_fn — metadata state for log compaction."""
+        with self.lock:
+            return {
+                "topics": topics_to_wire(self.topics),
+                "live": list(self.live),
+                "consumers": dict(self.consumers),
+            }
+
+    def restore(self, state: dict) -> None:
+        """hostraft restore_fn — install a metadata snapshot."""
+        with self.lock:
+            self.consumers = {str(k): int(v) for k, v in state["consumers"].items()}
+            self._apply_set_topics(
+                topics_from_wire(state["topics"]), [int(b) for b in state["live"]]
+            )
+
+    def _apply_register_consumer(self, name: str, slot: int) -> None:
+        """Idempotent consumer registration. The proposed slot was chosen
+        from a PRE-proposal read, so two concurrent registrations can
+        propose the same slot; the apply path (serialized by the Raft log,
+        identical on every broker) resolves the collision by assigning the
+        lowest free slot instead."""
+        if name in self.consumers:
+            return
+        used = set(self.consumers.values())
+        if slot in used:
+            C = self.config.engine.max_consumers
+            free = [s for s in range(C) if s not in used]
+            if not free:
+                return  # table full; registration request will time out
+            slot = free[0]
+        self.consumers[name] = slot
+
+    def _apply_set_topics(self, topics: list[Topic], live: list[int]) -> None:
+        old_alive = self._alive_mask() if self.dataplane is not None else None
+        self.topics = topics
+        self.live = live
+        if self.dataplane is None:
+            return
+        self._push_control_tables()
+        # Repair: replica slots that just came (back) alive have missed
+        # commits; copy the leader's partition state over them. Under
+        # atomic rounds a lagging replica never diverges, so a full-slot
+        # copy from the leader is always safe.
+        new_alive = self._alive_mask()
+        came_alive = new_alive & ~old_alive
+        self._resync_slots(came_alive)
+
+    def _apply_set_leader(
+        self, topic: str, pid: int, leader: Optional[int], term: int
+    ) -> None:
+        for i, t in enumerate(self.topics):
+            if t.name != topic:
+                continue
+            assigns = list(t.assignments)
+            for j, a in enumerate(assigns):
+                if a.partition_id == pid:
+                    assigns[j] = dataclasses.replace(a, leader=leader, term=term)
+            self.topics[i] = t.with_assignments(tuple(assigns))
+        if self.dataplane is not None:
+            slot = self.slot_map.get((topic, pid))
+            if slot is not None:
+                assign = self.assignment_of((topic, pid))
+                leader_slot = -1
+                if assign and leader is not None and leader in assign.replicas:
+                    leader_slot = assign.replicas.index(leader)
+                self.dataplane.set_leader(slot, leader_slot, term)
+
+    # -------------------------------------------------- control-table sync
+
+    def _alive_mask(self) -> np.ndarray:
+        """[P, R] mask: replica slot r of partition p is alive iff the
+        broker holding it is in the live set. Unassigned slots are dead."""
+        cfg = self.dataplane.cfg
+        alive = np.zeros((cfg.partitions, cfg.replicas), bool)
+        live = set(self.live)
+        for t in self.topics:
+            for a in t.assignments:
+                slot = self.slot_map.get((t.name, a.partition_id))
+                if slot is None:
+                    continue
+                for r, b in enumerate(a.replicas[: cfg.replicas]):
+                    alive[slot, r] = b in live
+        return alive
+
+    def _push_control_tables(self) -> None:
+        cfg = self.dataplane.cfg
+        quorum = np.full((cfg.partitions,), cfg.quorum, np.int32)
+        for t in self.topics:
+            q = t.replication_factor // 2 + 1
+            for a in t.assignments:
+                slot = self.slot_map.get((t.name, a.partition_id))
+                if slot is None:
+                    continue
+                quorum[slot] = q
+                leader_slot = -1
+                if a.leader is not None and a.leader in a.replicas:
+                    leader_slot = a.replicas.index(a.leader)
+                self.dataplane.set_leader(slot, leader_slot, a.term)
+        self.dataplane.set_quorum(quorum)
+        self.dataplane.set_alive(self._alive_mask())
+
+    def _resync_slots(self, came_alive: np.ndarray) -> None:
+        """Group newly-alive (partition, replica-slot) cells by (leader
+        slot, dst slot) and issue batched resyncs."""
+        pairs: dict[tuple[int, int], list[int]] = {}
+        for key, slot in self.slot_map.items():
+            assign = self.assignment_of(key)
+            if assign is None or assign.leader is None:
+                continue
+            if assign.leader not in assign.replicas:
+                continue
+            src = assign.replicas.index(assign.leader)
+            for r in range(self.dataplane.cfg.replicas):
+                if came_alive[slot, r] and r != src:
+                    pairs.setdefault((src, r), []).append(slot)
+        for (src, dst), slots in pairs.items():
+            self.dataplane.resync(src, dst, slots)
+
+    # ------------------------------------------------------------- queries
+
+    def get_topics(self) -> list[Topic]:
+        with self.lock:
+            return list(self.topics)
+
+    def assignment_of(self, key: GroupKey) -> Optional[PartitionAssignment]:
+        topic, pid = key
+        for t in self.topics:
+            if t.name == topic:
+                return t.assignment_for(pid)
+        return None
+
+    def leader_of(self, key: GroupKey) -> Optional[int]:
+        with self.lock:
+            a = self.assignment_of(key)
+            return a.leader if a else None
+
+    def slot_of(self, key: GroupKey) -> Optional[int]:
+        return self.slot_map.get(key)
+
+    def replica_slot(self, key: GroupKey, broker_id: int) -> Optional[int]:
+        """This broker's replica-slot index within the partition's set."""
+        with self.lock:
+            a = self.assignment_of(key)
+            if a is None or broker_id not in a.replicas:
+                return None
+            return a.replicas.index(broker_id)
+
+    def consumer_slot(self, consumer: str) -> Optional[int]:
+        with self.lock:
+            return self.consumers.get(consumer)
+
+    def next_consumer_slot(self) -> int:
+        """Lowest unused consumer slot (proposals are idempotent: the
+        first registration for a name wins, duplicates are no-ops)."""
+        with self.lock:
+            used = set(self.consumers.values())
+            C = self.config.engine.max_consumers
+            for s in range(C):
+                if s not in used:
+                    return s
+            raise RuntimeError(f"consumer table full ({C} slots)")
+
+    # ------------------------------------------- cluster-leader duty logic
+
+    def plan_assignment(self, alive_brokers: list[int]) -> Optional[dict]:
+        """Called on the metadata leader: if the live set changed (or no
+        assignments exist yet), return a set_topics command to propose —
+        the reference's membership-monitor + assigner path
+        (TopicsRaftServer.java:202-217 → PartitionManager.java:72-109)."""
+        with self.lock:
+            have_assignments = any(t.assignments for t in self.topics)
+            if have_assignments and sorted(alive_brokers) == sorted(self.live):
+                return None
+            base = self.topics if have_assignments else list(self.config.topics)
+            try:
+                new_topics = assign_partitions(
+                    list(self.config.topics), alive_brokers,
+                    previous=base if have_assignments else None,
+                )
+            except ValueError:
+                return None  # not enough live brokers to meet RF; keep old
+            return {
+                "op": OP_SET_TOPICS,
+                "topics": topics_to_wire(new_topics),
+                "live": sorted(alive_brokers),
+            }
+
+    # --------------------------------------------- controller duty logic
+
+    def plan_elections(self) -> tuple[dict[int, tuple[int, int]], dict[int, dict]]:
+        """Controller: find partitions whose leader is unknown or dead and
+        pick candidates (the alive replica with the longest log — vote_step
+        still enforces log-up-to-dateness on device). Returns
+        (candidates for DataPlane.elect, slot → set_leader command draft).
+        """
+        with self.lock:
+            if self.dataplane is None:
+                return {}, {}
+            log_ends = self.dataplane.log_ends()          # [R, P]
+            device_terms = self.dataplane.current_terms() # [P]
+            live = set(self.live)
+            cands: dict[int, tuple[int, int]] = {}
+            drafts: dict[int, dict] = {}
+            for t in self.topics:
+                for a in t.assignments:
+                    slot = self.slot_map.get((t.name, a.partition_id))
+                    if slot is None:
+                        continue
+                    if a.leader is not None and a.leader in live:
+                        continue
+                    alive_replicas = [
+                        (r, b)
+                        for r, b in enumerate(a.replicas)
+                        if b in live and r < self.dataplane.cfg.replicas
+                    ]
+                    if len(alive_replicas) < t.replication_factor // 2 + 1:
+                        continue  # no quorum: stay leaderless
+                    r_best, b_best = max(
+                        alive_replicas, key=lambda rb: (int(log_ends[rb[0], slot]), -rb[0])
+                    )
+                    new_term = max(a.term, int(device_terms[slot])) + 1
+                    cands[slot] = (r_best, new_term)
+                    drafts[slot] = {
+                        "op": OP_SET_LEADER,
+                        "topic": t.name,
+                        "partition": a.partition_id,
+                        "leader": b_best,
+                        "term": new_term,
+                    }
+            return cands, drafts

@@ -13,10 +13,14 @@ and advances every partition's commit index in a single psum round:
   2. A replica *acks* iff it is alive, its log end matches the leader's
      pre-append log end (the Raft log-matching check) and the leader's
      term is current.
-  3. Acking replicas append the batch into their slotted log.
-  4. votes = lax.psum(ack) over the replica axis; quorum ⇒ the commit
-     index advances (the majority-match rule of Raft, replacing JRaft's
-     per-entry ballot).
+  3. votes = lax.psum(ack) over the replica axis — the ballot happens
+     BEFORE any write (the ack predicate only reads pre-round state).
+  4. Rounds are atomic: iff the ballot reached quorum, acking replicas
+     append the batch and advance commit; a failed round leaves no trace
+     on any replica, so retries are always safe. (Wire Raft instead lets
+     leader/follower logs diverge and repairs them with nextIndex
+     backtracking — pointless here, where ballot + write are one fused
+     device program.)
   5. Committed offset updates are scattered into the replicated
      consumer-offset table (the reference routes these through the same
      per-partition Raft log — PartitionStateMachine.java:71-77).
@@ -92,15 +96,38 @@ def _append_one(
     return log_data, log_len, log_term
 
 
+def _normalize_alive(alive: jax.Array, P: int, R: int) -> jax.Array:
+    """Accept a [R] cluster-wide or [P, R] per-partition liveness mask.
+
+    Per-partition masks exist because each partition maps its replica
+    slots to different brokers (sticky assignment): one dead broker kills
+    slot 2 of one partition and slot 0 of another (the reference's
+    per-group peer lists, PartitionRaftServer.java:83).
+    """
+    if alive.ndim == 1:
+        return jnp.broadcast_to(alive[None, :], (P, R))
+    return alive
+
+
 def replica_step(
     cfg: EngineConfig,
     state: ReplicaState,
     inp: StepInput,
     rep_idx: jax.Array,   # int32 scalar — this replica's id on the axis
-    alive: jax.Array,     # bool [R]     — membership mask (replicated)
+    alive: jax.Array,     # bool [R] or [P, R] — membership mask (replicated)
+    quorum: jax.Array | None = None,  # int32 [P] — per-partition quorum
 ) -> tuple[ReplicaState, StepOutput]:
-    """One replication round, from one replica's point of view."""
+    """One replication round, from one replica's point of view.
+
+    `quorum` is per-partition because topics can carry different
+    replication factors than the mesh's replica-axis size: a partition
+    with RF 3 on an R=5 program commits at 2 acks, with its two unused
+    slots permanently masked dead in `alive`.
+    """
     S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
+    P = cfg.partitions
+    if quorum is None:
+        quorum = jnp.full((P,), cfg.quorum, jnp.int32)
 
     # Sanitize host-fed control values: an out-of-range index is undefined
     # behavior on TPU gathers (observed: backend InvalidArgument), and an
@@ -109,11 +136,16 @@ def replica_step(
     counts = jnp.clip(inp.counts, 0, B)
     inp = inp._replace(counts=counts)
 
-    self_alive = alive[rep_idx]
+    alive = _normalize_alive(alive, P, R)                # [P, R]
+    self_alive = alive[:, rep_idx]                       # [P]
     leader_known = (inp.leader >= 0) & (inp.leader < R)  # [P]
     is_leader = (inp.leader == rep_idx) & leader_known   # [P]
     leader_alive = jnp.where(
-        leader_known, alive[jnp.clip(inp.leader, 0, R - 1)], False
+        leader_known,
+        jnp.take_along_axis(
+            alive, jnp.clip(inp.leader, 0, R - 1)[:, None], axis=1
+        )[:, 0],
+        False,
     )
 
     # --- 1. leader's pre-append log end ("prevLogIndex" of AppendEntries)
@@ -154,7 +186,21 @@ def replica_step(
     # Followers adopt the leader's (host/election-issued) term.
     new_current_term = jnp.maximum(state.current_term, inp.term)
 
-    # --- 3. append the batch on acking replicas (vmapped over partitions).
+    # --- 3. quorum vote FIRST: count acks across the replica axis. The
+    # ack predicate depends only on pre-round state, so the ballot can
+    # precede the write — and therefore gate it.
+    votes = lax.psum(ack.astype(jnp.int32), AXIS)          # [P]
+    committed = votes >= quorum                            # [P]
+
+    # --- 4. ATOMIC ROUNDS: writes land only where the round committed.
+    # A failed round (no quorum) leaves no trace on ANY replica — leader
+    # included — so host-level retries can never create divergent or
+    # duplicate entries. This is a deliberate departure from wire Raft
+    # (where a leader appends locally first and followers converge later
+    # via nextIndex backtracking): on TPU the ballot and the write are one
+    # fused program, so the log simply never holds uncommitted entries,
+    # and replica repair reduces to the explicit host resync path.
+    do_write = ack & committed                             # [P]
     log_data, log_len, log_term = jax.vmap(_append_one)(
         state.log_data,
         state.log_len,
@@ -162,20 +208,14 @@ def replica_step(
         inp.entries,
         inp.lens,
         inp.counts,
-        jnp.where(ack, base, 0),
+        jnp.where(do_write, base, 0),
         inp.term,
-        ack,
+        do_write,
     )
-    new_log_end = jnp.where(ack, base + inp.counts, state.log_end)
+    new_log_end = jnp.where(do_write, base + inp.counts, state.log_end)
 
-    # --- 4. quorum vote: count acks across the replica axis.
-    votes = lax.psum(ack.astype(jnp.int32), AXIS)          # [P]
-    committed = votes >= cfg.quorum                        # [P]
-
-    # A replica moves its commit index only if it holds the entries
-    # (ack), mirroring Raft's commit = min(leaderCommit, lastIndex);
-    # commit never regresses.
-    commit_target = jnp.where(committed & ack, base + inp.counts, 0)
+    # Commit index == log end on every writing replica; never regresses.
+    commit_target = jnp.where(do_write, base + inp.counts, 0)
     new_commit = jnp.maximum(state.commit, commit_target)
 
     # --- 5. committed consumer-offset updates (scatter into the table).
@@ -186,7 +226,7 @@ def replica_step(
     U = cfg.max_offset_updates
     off_counts = jnp.clip(inp.off_counts, 0, U)
     off_valid = (jnp.arange(U, dtype=jnp.int32)[None, :] < off_counts[:, None])
-    off_apply = off_valid & (committed & ack)[:, None]      # [P, U]
+    off_apply = off_valid & do_write[:, None]               # [P, U]
     C = cfg.max_consumers
     scatter_idx = jnp.where(off_apply, inp.off_slots, C)    # C = out of range → dropped
 
@@ -220,6 +260,7 @@ def vote_step(
     cand_term: jax.Array,  # int32 [P] — candidate's proposed term
     rep_idx: jax.Array,
     alive: jax.Array,
+    quorum: jax.Array | None = None,  # int32 [P]
 ) -> tuple[ReplicaState, jax.Array, jax.Array]:
     """One RequestVote round: grants counted as a psum reduction.
 
@@ -230,10 +271,17 @@ def vote_step(
     PartitionRaftServer.java:85 — with timeouts host-vectorized).
     """
     R = cfg.replicas
+    alive = _normalize_alive(alive, cfg.partitions, R)  # [P, R]
+    if quorum is None:
+        quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
     electing = (cand >= 0) & (cand < R)
     is_cand = (cand == rep_idx) & electing
-    self_alive = alive[rep_idx]
-    cand_alive = jnp.where(electing, alive[jnp.clip(cand, 0, R - 1)], False)
+    self_alive = alive[:, rep_idx]
+    cand_alive = jnp.where(
+        electing,
+        jnp.take_along_axis(alive, jnp.clip(cand, 0, R - 1)[:, None], axis=1)[:, 0],
+        False,
+    )
 
     last_idx = jnp.maximum(state.log_end - 1, 0)
     my_last_term = jnp.where(
@@ -250,7 +298,7 @@ def vote_step(
     grant = electing & self_alive & cand_alive & (cand_term > state.current_term) & up_to_date
 
     votes = lax.psum(grant.astype(jnp.int32), AXIS)
-    elected = votes >= cfg.quorum
+    elected = votes >= quorum
 
     new_term = jnp.where(grant, cand_term, state.current_term)
     return state._replace(current_term=new_term), elected, votes

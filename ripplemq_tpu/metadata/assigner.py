@@ -51,6 +51,7 @@ def assign_partitions(
     # same way, PartitionAssigner.java:50-67).
     survivors: dict[tuple[str, int], list[int]] = {}
     prev_leaders: dict[tuple[str, int], int | None] = {}
+    prev_terms: dict[tuple[str, int], int] = {}
     for topic in topics:
         if topic.replication_factor > len(live):
             raise ValueError(
@@ -69,6 +70,7 @@ def assign_partitions(
                 load[b] += 1
             survivors[(topic.name, pid)] = kept
             prev_leaders[(topic.name, pid)] = prev_assign.leader if prev_assign else None
+            prev_terms[(topic.name, pid)] = prev_assign.term if prev_assign else 0
 
     # Pass 2: top up each partition to RF with the least-loaded live broker
     # not already holding it (ties → lowest broker id).
@@ -85,7 +87,9 @@ def assign_partitions(
             prev_leader = prev_leaders[(topic.name, pid)]
             leader = prev_leader if prev_leader in replicas else None
             assignments.append(
-                PartitionAssignment(pid, tuple(replicas), leader)
+                PartitionAssignment(
+                    pid, tuple(replicas), leader, prev_terms[(topic.name, pid)]
+                )
             )
         out.append(topic.with_assignments(tuple(assignments)))
     return out

@@ -34,6 +34,17 @@ class ClusterConfig:
     membership_poll_s: float = 10.0
     metadata_refresh_s: float = 10.0
     rpc_timeout_s: float = 3.0
+    # The broker that drives the TPU mesh (device-program controller).
+    # None → lowest broker id. The reference has no such role — every JVM
+    # broker replicates; here the data plane is a single SPMD program and
+    # the other brokers are serving/metadata frontends reaching it by RPC.
+    controller_id: int | None = None
+
+    @property
+    def controller(self) -> int:
+        if self.controller_id is not None:
+            return self.controller_id
+        return min(b.broker_id for b in self.brokers)
 
     def broker(self, broker_id: int) -> BrokerInfo:
         for b in self.brokers:
@@ -103,5 +114,7 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         "metadata_refresh_s",
         "rpc_timeout_s",
     )
-    timings = {k: float(raw[k]) for k in timing_keys if k in raw}
-    return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **timings)
+    extra = {k: float(raw[k]) for k in timing_keys if k in raw}
+    if raw.get("controller_id") is not None:
+        extra["controller_id"] = int(raw["controller_id"])
+    return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **extra)

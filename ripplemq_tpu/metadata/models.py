@@ -62,18 +62,22 @@ class PartitionAssignment:
 
     `leader` is a broker id, or None while no leader is known — the same
     "unset until the partition group elects and advertises" fixpoint as the
-    reference (PartitionManager.java:200-275).
+    reference (PartitionManager.java:200-275). `term` is the partition's
+    replication term, bumped on every leader change (the engine stamps log
+    entries with it; the reference leaves terms inside JRaft).
     """
 
     partition_id: int
     replicas: tuple[int, ...]          # broker ids, stable order
     leader: Optional[int] = None
+    term: int = 0
 
     def to_dict(self) -> dict:
         return {
             "partition_id": self.partition_id,
             "replicas": list(self.replicas),
             "leader": self.leader,
+            "term": self.term,
         }
 
     @staticmethod
@@ -83,6 +87,7 @@ class PartitionAssignment:
             int(d["partition_id"]),
             tuple(int(r) for r in d["replicas"]),
             None if leader is None else int(leader),
+            int(d.get("term", 0)),
         )
 
 

@@ -83,6 +83,7 @@ def _resync(cfg: EngineConfig, state: ReplicaState, src: jax.Array,
 def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
     R = cfg.replicas
     rep_idx = jnp.arange(R, dtype=jnp.int32)
+    default_quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
 
     @jax.jit
     def _init():
@@ -91,26 +92,35 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
 
     vstep = jax.vmap(
         functools.partial(core_step.replica_step, cfg),
-        in_axes=(0, None, 0, None),
+        in_axes=(0, None, 0, None, None),
         axis_name=core_step.AXIS,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _step(state, inp: StepInput, alive):
-        new_state, out = vstep(state, inp, rep_idx, alive)
+    def _step_j(state, inp: StepInput, alive, quorum):
+        new_state, out = vstep(state, inp, rep_idx, alive, quorum)
         # outputs are replica-invariant after the psum; take replica 0's copy
         return new_state, jax.tree.map(lambda x: x[0], out)
 
+    def _step(state, inp, alive, quorum=None):
+        return _step_j(state, inp, alive,
+                       default_quorum if quorum is None else quorum)
+
     vvote = jax.vmap(
         functools.partial(core_step.vote_step, cfg),
-        in_axes=(0, None, None, 0, None),
+        in_axes=(0, None, None, 0, None, None),
         axis_name=core_step.AXIS,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _vote(state, cand, cand_term, alive):
-        new_state, elected, votes = vvote(state, cand, cand_term, rep_idx, alive)
+    def _vote_j(state, cand, cand_term, alive, quorum):
+        new_state, elected, votes = vvote(state, cand, cand_term, rep_idx,
+                                          alive, quorum)
         return new_state, elected[0], votes[0]
+
+    def _vote(state, cand, cand_term, alive, quorum=None):
+        return _vote_j(state, cand, cand_term, alive,
+                       default_quorum if quorum is None else quorum)
 
     @jax.jit
     def _read(state, replica, partition, offset):
@@ -185,41 +195,61 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     def _expand(tree):
         return jax.tree.map(lambda x: x[None], tree)
 
+    def _norm_alive(alive):
+        """Engine-level liveness is always [P, R] (per-partition replica
+        masks; see core.step._normalize_alive); a [R] mask is broadcast."""
+        alive = jnp.asarray(alive)
+        if alive.ndim == 1:
+            alive = jnp.broadcast_to(alive[None, :], (cfg.partitions, R))
+        return alive
+
+    default_quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
+
     # ---- step -------------------------------------------------------------
-    def step_body(state, inp, rep, alive):
+    def step_body(state, inp, rep, alive, quorum):
         st = _squeeze(state)          # strip the size-1 replica block dim
-        new_st, out = core_step.replica_step(cfg, st, inp, rep[0], alive)
+        new_st, out = core_step.replica_step(cfg, st, inp, rep[0], alive, quorum)
         return _expand(new_st), out   # out is psum-replicated over "replica"
 
     smapped_step = _shard_map(
         step_body,
         mesh=mesh,
-        in_specs=(st_specs, in_specs, P("replica"), P()),
+        in_specs=(st_specs, in_specs, P("replica"), P("part", None), P("part")),
         out_specs=(st_specs, StepOutput(P("part"), P("part"), P("part"), P("part"))),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _step(state, inp, alive):
-        return smapped_step(state, inp, rep_ids, alive)
+    def _step_j(state, inp, alive, quorum):
+        return smapped_step(state, inp, rep_ids, _norm_alive(alive), quorum)
+
+    def _step(state, inp, alive, quorum=None):
+        return _step_j(state, inp, alive,
+                       default_quorum if quorum is None else quorum)
 
     # ---- vote -------------------------------------------------------------
-    def vote_body(state, cand, cand_term, rep, alive):
+    def vote_body(state, cand, cand_term, rep, alive, quorum):
         st = _squeeze(state)
         new_st, elected, votes = core_step.vote_step(
-            cfg, st, cand, cand_term, rep[0], alive
+            cfg, st, cand, cand_term, rep[0], alive, quorum
         )
         return _expand(new_st), elected, votes
 
     smapped_vote = _shard_map(
         vote_body,
         mesh=mesh,
-        in_specs=(st_specs, P("part"), P("part"), P("replica"), P()),
+        in_specs=(st_specs, P("part"), P("part"), P("replica"),
+                  P("part", None), P("part")),
         out_specs=(st_specs, P("part"), P("part")),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _vote(state, cand, cand_term, alive):
-        return smapped_vote(state, cand, cand_term, rep_ids, alive)
+    def _vote_j(state, cand, cand_term, alive, quorum):
+        return smapped_vote(state, cand, cand_term, rep_ids,
+                            _norm_alive(alive), quorum)
+
+    def _vote(state, cand, cand_term, alive, quorum=None):
+        return _vote_j(state, cand, cand_term, alive,
+                       default_quorum if quorum is None else quorum)
 
     # ---- read (broadcast the serving replica's window to every device) ----
     def read_body(state, rep, replica, partition, offset):

@@ -1,0 +1,98 @@
+"""In-process N-broker cluster harness (the single-process fake-transport
+multi-broker test rig SURVEY.md §4 prescribes — the reference could only
+exercise multi-broker behavior inside docker-compose)."""
+
+from __future__ import annotations
+
+import time
+
+from ripplemq_tpu.broker.server import BrokerServer
+from ripplemq_tpu.metadata.cluster_config import ClusterConfig
+from ripplemq_tpu.metadata.models import BrokerInfo, Topic
+from ripplemq_tpu.wire import InProcNetwork
+from tests.helpers import small_cfg
+
+
+def make_config(n_brokers=3, topics=None, engine=None, **kw) -> ClusterConfig:
+    topics = topics or (Topic("topic1", 2, 3), Topic("topic2", 1, 3))
+    engine = engine or small_cfg(
+        partitions=sum(t.partitions for t in topics),
+        replicas=max(t.replication_factor for t in topics),
+    )
+    return ClusterConfig(
+        brokers=tuple(
+            BrokerInfo(i, "broker", 9000 + i) for i in range(n_brokers)
+        ),
+        topics=tuple(topics),
+        engine=engine,
+        rpc_timeout_s=kw.pop("rpc_timeout_s", 5.0),
+        **kw,
+    )
+
+
+class InProcCluster:
+    def __init__(self, config: ClusterConfig | None = None, n_brokers=3):
+        self.config = config or make_config(n_brokers)
+        self.net = InProcNetwork()
+        self.brokers: dict[int, BrokerServer] = {}
+        for b in self.config.brokers:
+            self.brokers[b.broker_id] = BrokerServer(
+                b.broker_id,
+                self.config,
+                net=self.net,
+                tick_interval_s=0.02,
+                duty_interval_s=0.05,
+            )
+
+    def start(self) -> None:
+        for b in self.brokers.values():
+            b.start()
+
+    def stop(self) -> None:
+        for b in self.brokers.values():
+            b.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- convenience --
+    def client(self, name="client"):
+        return self.net.client(name)
+
+    def wait_for_leaders(self, timeout=30.0) -> None:
+        """Block until every configured partition has an advertised leader
+        on every broker's view (the bootstrap fixpoint, SURVEY.md §3.1)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self._all_leaders_known(b) for b in self.brokers.values()):
+                return
+            time.sleep(0.05)
+        states = {
+            i: [
+                (t.name, a.partition_id, a.leader)
+                for t in b.manager.get_topics()
+                for a in t.assignments
+            ]
+            for i, b in self.brokers.items()
+        }
+        raise AssertionError(f"leaders not established: {states}")
+
+    def _all_leaders_known(self, broker: BrokerServer) -> bool:
+        topics = broker.manager.get_topics()
+        if not topics or not any(t.assignments for t in topics):
+            return False
+        for t in topics:
+            for a in t.assignments:
+                if a.leader is None:
+                    return False
+        return True
+
+    def leader_broker(self, topic: str, partition: int) -> BrokerServer:
+        any_b = next(iter(self.brokers.values()))
+        leader = any_b.manager.leader_of((topic, partition))
+        assert leader is not None
+        return self.brokers[leader]

@@ -1,0 +1,163 @@
+"""DataPlane: batched rounds, futures, retries, elections, liveness masks."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
+from tests.helpers import small_cfg
+
+
+@pytest.fixture()
+def dp():
+    plane = DataPlane(small_cfg(), mode="local", max_retry_rounds=3)
+    plane.start()
+    yield plane
+    plane.stop()
+
+
+def test_append_commits_and_assigns_offsets(dp):
+    dp.set_leader(0, 0, 1)
+    f1 = dp.submit_append(0, [b"m0", b"m1"])
+    f2 = dp.submit_append(0, [b"m2"])
+    assert f1.result(timeout=10) == 0
+    assert f2.result(timeout=10) == 2
+    msgs, end = dp.read(0, 0, replica=0)
+    assert msgs == [b"m0", b"m1", b"m2"] and end == 3
+    assert dp.commit_index(0) == 3
+
+
+def test_many_submitters_coalesce_into_rounds(dp):
+    dp.set_leader(1, 2, 1)
+    futs = [dp.submit_append(1, [f"m{i}".encode()]) for i in range(50)]
+    offsets = sorted(f.result(timeout=20) for f in futs)
+    assert offsets == list(range(50))
+    msgs, _ = dp.read(1, 0, replica=2)
+    assert len(msgs) == dp.cfg.read_batch  # window-limited
+    assert dp.commit_index(1) == 50
+    # Far fewer device rounds than submits is the whole point.
+    assert dp.rounds < 50
+
+
+def test_offsets_replicate_with_quorum(dp):
+    dp.set_leader(2, 0, 1)
+    dp.submit_append(2, [b"x"]).result(timeout=10)
+    assert dp.submit_offsets(2, [(3, 1)]).result(timeout=10) is True
+    assert dp.read_offset(2, 3) == 1
+
+
+def test_no_leader_fails_after_retries(dp):
+    f = dp.submit_append(3, [b"m"])  # no leader set for slot 3
+    with pytest.raises(NotCommittedError):
+        f.result(timeout=20)
+
+
+def test_dead_majority_blocks_commit_then_recovery(dp):
+    dp.set_leader(0, 0, 1)
+    alive = np.ones((dp.cfg.partitions, dp.cfg.replicas), bool)
+    alive[0, 1] = alive[0, 2] = False  # only the leader replica lives
+    dp.set_alive(alive)
+    with pytest.raises(NotCommittedError):
+        dp.submit_append(0, [b"m"]).result(timeout=20)
+    dp.set_alive(np.ones((dp.cfg.partitions, dp.cfg.replicas), bool))
+    assert dp.submit_append(0, [b"m"]).result(timeout=10) == 0
+
+
+def test_per_partition_alive_masks_are_independent(dp):
+    alive = np.ones((dp.cfg.partitions, dp.cfg.replicas), bool)
+    alive[1, 0] = alive[1, 1] = False  # partition 1 lost its quorum
+    dp.set_alive(alive)
+    dp.set_leader(0, 0, 1)
+    dp.set_leader(1, 2, 1)
+    ok = dp.submit_append(0, [b"fine"])
+    bad = dp.submit_append(1, [b"stuck"])
+    assert ok.result(timeout=10) == 0
+    with pytest.raises(NotCommittedError):
+        bad.result(timeout=20)
+
+
+def test_batched_election_round(dp):
+    winners = dp.elect({0: (1, 1), 2: (0, 1)})
+    assert winners == {0: True, 2: True}
+    # Stale term loses.
+    dp.set_leader(0, 1, 1)
+    dp.submit_append(0, [b"m"])  # bumps replica current_term to 1 via round
+    losers = dp.elect({0: (2, 0)})
+    assert losers[0] is False
+
+
+def test_validation_errors_are_immediate(dp):
+    with pytest.raises(ValueError):
+        dp.submit_append(999, [b"m"]).result(timeout=1)
+    with pytest.raises(ValueError):
+        dp.submit_append(0, []).result(timeout=1)
+    with pytest.raises(ValueError):
+        dp.submit_append(0, [b"x" * 1000]).result(timeout=1)
+    with pytest.raises(ValueError):
+        dp.submit_append(0, [b"x"] * 100).result(timeout=1)
+    with pytest.raises(ValueError):
+        dp.submit_offsets(0, [(999, 1)]).result(timeout=1)
+
+
+def test_concurrent_submitters_from_threads(dp):
+    dp.set_leader(0, 0, 1)
+    dp.set_leader(1, 0, 1)
+    results = {}
+
+    def worker(i):
+        slot = i % 2
+        results[i] = dp.submit_append(slot, [f"t{i}".encode()]).result(timeout=20)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 20
+    # Offsets within each partition are unique and dense.
+    for slot in (0, 1):
+        offs = sorted(v for k, v in results.items() if k % 2 == slot)
+        assert offs == list(range(10))
+
+
+def test_resync_recovers_lagging_replica(dp):
+    dp.set_leader(0, 0, 2)
+    alive = np.ones((dp.cfg.partitions, dp.cfg.replicas), bool)
+    alive[0, 2] = False
+    dp.set_alive(alive)
+    dp.submit_append(0, [b"a", b"b"]).result(timeout=10)
+    # Replica 2 comes back empty; resync from leader slot 0, then it acks.
+    dp.resync(0, 2, [0])
+    dp.set_alive(np.ones((dp.cfg.partitions, dp.cfg.replicas), bool))
+    dp.submit_append(0, [b"c"]).result(timeout=10)
+    msgs, _ = dp.read(0, 0, replica=2)
+    assert msgs == [b"a", b"b", b"c"]
+
+
+def test_partition_full_is_terminal_backpressure():
+    from ripplemq_tpu.broker.dataplane import PartitionFullError
+
+    cfg = small_cfg(slots=8, max_batch=8)
+    dp = DataPlane(cfg, mode="local", max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        assert dp.submit_append(0, [b"x"] * 8).result(timeout=10) == 0
+        with pytest.raises(PartitionFullError):
+            dp.submit_append(0, [b"y"]).result(timeout=10)
+    finally:
+        dp.stop()
+
+
+def test_consumer_slot_collision_resolved_in_apply():
+    from ripplemq_tpu.broker.manager import PartitionManager
+    from tests.broker_harness import make_config
+
+    config = make_config(3)
+    m = PartitionManager(0, config)
+    m.apply(1, {"op": "register_consumer", "consumer": "a", "slot": 0})
+    m.apply(2, {"op": "register_consumer", "consumer": "b", "slot": 0})
+    m.apply(3, {"op": "register_consumer", "consumer": "a", "slot": 5})  # dup
+    assert m.consumer_slot("a") == 0
+    assert m.consumer_slot("b") == 1  # collision moved to lowest free

@@ -1,0 +1,140 @@
+"""Failure handling: broker death → membership change → reassignment →
+re-election → service resumes (the reference's §3.5 recovery flow, here
+exercised deterministically in-process — the reference needed a live
+docker-compose cluster to even observe this).
+
+Architecture note (single-controller mode): a broker process death costs
+its serving endpoints, its metadata-Raft vote and its partition
+leaderships — NOT the device-side replica data, which lives in the always
+-running SPMD program. The membership machinery (liveness → sticky
+reassignment → election → advertisement) is identical to the reference's;
+what differs is that "replica healing" needs no data copy unless a device
+shard was actually lost (then: resync path).
+"""
+
+import time
+
+import pytest
+
+from ripplemq_tpu.metadata.models import Topic
+from tests.broker_harness import InProcCluster, make_config
+
+
+@pytest.fixture()
+def cluster5():
+    config = make_config(
+        n_brokers=5,
+        topics=(Topic("t", 3, 3),),
+        metadata_election_timeout_s=0.6,
+        membership_poll_s=0.2,
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        yield c
+
+
+def wait_until(pred, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_broker_death_heals_assignment_and_leadership(cluster5):
+    c = cluster5
+    controller_id = c.config.controller
+    # Pick a victim that leads at least one partition and is not controller.
+    any_b = next(iter(c.brokers.values()))
+    leaders = {
+        a.partition_id: a.leader
+        for t in any_b.manager.get_topics()
+        for a in t.assignments
+    }
+    victim = next(
+        b for b in leaders.values() if b is not None and b != controller_id
+    )
+    led = [pid for pid, b in leaders.items() if b == victim]
+    assert led, "victim should lead something"
+
+    # Kill it: unreachable on the network AND stopped.
+    c.net.set_down(c.brokers[victim].addr)
+    c.brokers[victim].stop()
+
+    survivors = [b for i, b in c.brokers.items() if i != victim]
+
+    def healed():
+        for b in survivors:
+            topics = b.manager.get_topics()
+            for t in topics:
+                for a in t.assignments:
+                    if victim in a.replicas or a.leader in (None, victim):
+                        return False
+        return True
+
+    assert wait_until(healed, timeout=60), {
+        i: [
+            (a.partition_id, a.replicas, a.leader)
+            for t in b.manager.get_topics()
+            for a in t.assignments
+        ]
+        for i, b in c.brokers.items()
+        if i != victim
+    }
+
+    # Every partition accepts produces at its new leader.
+    client = c.client()
+    for pid in range(3):
+        leader_id = survivors[0].manager.leader_of(("t", pid))
+        resp = client.call(
+            c.brokers[leader_id].addr,
+            {"type": "produce", "topic": "t", "partition": pid,
+             "messages": [b"post-failover"]},
+            timeout=10.0,
+        )
+        assert resp["ok"], (pid, resp)
+
+    # Sticky: surviving replicas were retained (only the dead one replaced).
+    for b in survivors:
+        for t in b.manager.get_topics():
+            for a in t.assignments:
+                assert len(a.replicas) == 3
+                assert victim not in a.replicas
+
+
+def test_metadata_leader_death_reelects_and_heals(cluster5):
+    c = cluster5
+    meta_leader = next(
+        i for i, b in c.brokers.items()
+        if b.runner.node.role == "leader"
+    )
+    if meta_leader == c.config.controller:
+        pytest.skip("metadata leader landed on controller; covered elsewhere")
+    c.net.set_down(c.brokers[meta_leader].addr)
+    c.brokers[meta_leader].stop()
+
+    survivors = [b for i, b in c.brokers.items() if i != meta_leader]
+    assert wait_until(
+        lambda: sum(1 for b in survivors if b.runner.node.role == "leader") == 1,
+        timeout=60,
+    )
+    # New metadata leader resumes assignment duty: victim leaves replica sets.
+    def victim_gone():
+        return all(
+            meta_leader not in a.replicas and a.leader not in (None, meta_leader)
+            for b in survivors
+            for t in b.manager.get_topics()
+            for a in t.assignments
+        )
+
+    assert wait_until(victim_gone, timeout=60)
+    client = c.client()
+    leader_id = survivors[0].manager.leader_of(("t", 0))
+    resp = client.call(
+        c.brokers[leader_id].addr,
+        {"type": "produce", "topic": "t", "partition": 0,
+         "messages": [b"still alive"]},
+        timeout=10.0,
+    )
+    assert resp["ok"], resp

@@ -64,15 +64,19 @@ def test_spmd_vote_and_resync():
     spmd = make_spmd_fns(cfg, mesh)
     st = spmd.init()
 
-    # replica 1 dead; entries commit on majority-of-2? quorum(2)=2 -> no
+    # replica 1 dead; quorum(2) = 2 -> round fails, and ATOMICALLY: the
+    # failed round leaves no trace on any replica (leader included).
     st, out = spmd.step(
         st, make_input(cfg, appends={0: [b"a"]}), np.array([True, False])
     )
     assert not bool(out.committed[0])
+    data, lens, count = spmd.read(st, 0, 0, 0)
+    assert decode_read(data, lens, count) == []
 
-    # full quorum commits
-    st, out = spmd.step(st, make_input(cfg, appends={5: [b"b"]}), np.ones(2, bool))
-    assert bool(out.committed[5])
+    # full quorum commits (the host retries the same entry)
+    st, out = spmd.step(st, make_input(cfg, appends={0: [b"a"], 5: [b"b"]}),
+                        np.ones(2, bool))
+    assert bool(out.committed[0]) and bool(out.committed[5])
 
     # vote: replica 1 runs for partition 5 with a fresh term
     cand = np.full((8,), -1, np.int32)
@@ -82,7 +86,7 @@ def test_spmd_vote_and_resync():
     )
     assert bool(elected[5]) and int(votes[5]) == 2
 
-    # resync partition 0 (leader appended uncommitted entry) then commit
+    # resync is a no-op between in-sync replicas; state stays consistent
     mask = np.zeros((8,), bool)
     mask[0] = True
     st = spmd.resync(st, jnp.int32(0), jnp.int32(1), mask)
