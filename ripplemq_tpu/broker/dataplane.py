@@ -29,7 +29,7 @@ from typing import Optional
 import numpy as np
 
 from ripplemq_tpu.core.config import EngineConfig
-from ripplemq_tpu.core.encode import decode_entries
+from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
 from ripplemq_tpu.core.state import StepInput
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh
@@ -177,11 +177,17 @@ class DataPlane:
                     TypeError(f"payloads must be bytes, got {type(m).__name__}")
                 )
                 return fut
-            if len(m) > cfg.slot_bytes:
+            if len(m) == 0:
+                fut.set_exception(
+                    ValueError("empty messages are not supported (length-0 "
+                               "rows mark alignment padding)")
+                )
+                return fut
+            if len(m) > cfg.payload_bytes:
                 fut.set_exception(
                     ValueError(
-                        f"payload of {len(m)} bytes exceeds slot_bytes "
-                        f"{cfg.slot_bytes}"
+                        f"payload of {len(m)} bytes exceeds payload_bytes "
+                        f"{cfg.payload_bytes}"
                     )
                 )
                 return fut
@@ -203,6 +209,16 @@ class DataPlane:
         if not 0 <= slot < self.cfg.partitions:
             fut.set_exception(ValueError(f"partition slot {slot} out of range"))
             return fut
+        if len(updates) > self.cfg.max_offset_updates:
+            # An oversized pending could never fit a round and would wedge
+            # the slot's FIFO queue forever.
+            fut.set_exception(
+                ValueError(
+                    f"{len(updates)} offset updates exceed max_offset_updates "
+                    f"{self.cfg.max_offset_updates}"
+                )
+            )
+            return fut
         if not updates or any(not 0 <= s < C for s, _ in updates):
             fut.set_exception(ValueError(f"bad consumer slots in {updates}"))
             return fut
@@ -216,9 +232,15 @@ class DataPlane:
 
     # --------------------------------------------------------------- reads
 
-    def read(self, slot: int, offset: int, replica: int) -> tuple[list[bytes], int]:
-        """Committed entries of `slot` from `offset` as seen by `replica`;
-        returns (messages, end_offset). Replica-local, no quorum round —
+    def read(
+        self, slot: int, offset: int, replica: int,
+        max_msgs: Optional[int] = None,
+    ) -> tuple[list[bytes], int]:
+        """Committed messages of `slot` from storage offset `offset` as
+        seen by `replica`; returns (messages, next_offset). Offsets are
+        STORAGE offsets (rounds are ALIGN-padded), so the caller must
+        always continue from the returned `next_offset`, never from
+        `offset + len(messages)`. Replica-local, no quorum round —
         matching the reference's leader-local reads
         (PartitionStateMachine.handleBatchRead:85) but bounded by the
         commit index (stricter: never serves un-replicated entries)."""
@@ -226,8 +248,15 @@ class DataPlane:
             data, lens, count = self.fns.read(
                 self._state, np.int32(replica), np.int32(slot), np.int32(offset)
             )
-            msgs = decode_entries(data, lens, count)
-        return msgs, offset + len(msgs)
+            with_pos = decode_entries_with_pos(data, lens, count)
+        count = int(count)
+        if max_msgs is not None and len(with_pos) > max(0, max_msgs):
+            with_pos = with_pos[: max(0, max_msgs)]
+            # Continue right after the last returned message's row.
+            next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
+        else:
+            next_offset = offset + count
+        return [m for _, m in with_pos], next_offset
 
     def read_offset(self, slot: int, consumer_slot: int) -> int:
         with self._device_lock:
@@ -285,7 +314,6 @@ class DataPlane:
             if not self._appends and not self._offsets:
                 return None
             entries = np.zeros((P, B, SB), np.uint8)
-            lens = np.zeros((P, B), np.int32)
             counts = np.zeros((P,), np.int32)
             off_slots = np.zeros((P, U), np.int32)
             off_vals = np.zeros((P, U), np.int32)
@@ -297,15 +325,15 @@ class DataPlane:
             for slot, queue in list(self._appends.items()):
                 taken: list[tuple[_Pending, int, int]] = []
                 fill = 0
+                batch: list[bytes] = []
                 while queue and fill + len(queue[0].payloads) <= B:
                     pend = queue.pop(0)
                     n = len(pend.payloads)
                     taken.append((pend, fill, n))
-                    for i, m in enumerate(pend.payloads):
-                        entries[slot, fill + i, : len(m)] = np.frombuffer(m, np.uint8)
-                        lens[slot, fill + i] = len(m)
+                    batch.extend(pend.payloads)
                     fill += n
                 if taken:
+                    entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
                     counts[slot] = fill
                     round_appends[slot] = taken
                 if not queue:
@@ -329,10 +357,8 @@ class DataPlane:
 
             if not round_appends and not round_offsets:
                 return None
-            total_counts = counts.copy()
             inp = StepInput(
                 entries=entries,
-                lens=lens,
                 counts=counts,
                 off_slots=off_slots,
                 off_vals=off_vals,
@@ -343,7 +369,7 @@ class DataPlane:
             alive = self.alive.copy()
             quorum = self.quorum.copy()
         return inp, {"appends": round_appends, "offsets": round_offsets,
-                     "counts": total_counts, "alive": alive, "quorum": quorum}
+                     "alive": alive, "quorum": quorum}
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -391,10 +417,11 @@ class DataPlane:
                         pend.future.set_result(int(base[slot]) + start)
             else:
                 # Distinguish permanent backpressure (log full) from a
-                # transient quorum outage: base is the leader's log end, so
-                # base + round size > slots means no retry can ever fit.
+                # transient quorum outage: the write phase needs a full
+                # max_batch window past the leader's log end (base), so
+                # base + B > slots means no retry can ever fit.
                 full = (
-                    base[slot] + int(ctx["counts"][slot]) > self.cfg.slots
+                    base[slot] + self.cfg.max_batch > self.cfg.slots
                     and base[slot] > 0
                 )
                 for pend, _, _ in taken:

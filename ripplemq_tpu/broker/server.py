@@ -158,7 +158,16 @@ class BrokerServer:
             if t in RAFT_TYPES:
                 return self.runner.handle_rpc(req)
             if t == "meta.topics":
-                return {"ok": True, "topics": topics_to_wire(self.manager.get_topics())}
+                # Topics + broker roster: clients resolve leader broker ids
+                # to advertised addresses from here (the reference instead
+                # parsed "brokerN" out of hostnames and substituted
+                # bootstrap entries — ProducerClientImpl.java:101-107; that
+                # hack is deliberately not reproduced).
+                return {
+                    "ok": True,
+                    "topics": topics_to_wire(self.manager.get_topics()),
+                    "brokers": [b.to_dict() for b in self.config.brokers],
+                }
             if t == "meta.propose":
                 return self._handle_meta_propose(req)
             if t == "produce":
@@ -266,11 +275,14 @@ class BrokerServer:
         if replica is None:
             replica = 0  # leader not in replicas: metadata race; read slot 0
         offset = self._engine_read_offset(slot, cslot)
-        msgs, _ = self._engine_read(slot, offset, replica)
         limit = req.get("max_messages")
-        if limit is not None:
-            msgs = msgs[: max(0, int(limit))]
-        return {"ok": True, "messages": msgs, "offset": offset}
+        msgs, next_offset = self._engine_read(
+            slot, offset, replica, None if limit is None else int(limit)
+        )
+        # Offsets are storage offsets (rounds are alignment-padded), so the
+        # committable position is next_offset — NOT offset + len(messages).
+        return {"ok": True, "messages": msgs, "offset": offset,
+                "next_offset": next_offset}
 
     def _handle_offset_commit(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
@@ -347,12 +359,13 @@ class BrokerServer:
 
         return wait
 
-    def _engine_read(self, slot: int, offset: int, replica: int):
+    def _engine_read(self, slot: int, offset: int, replica: int,
+                     max_msgs: Optional[int] = None):
         if self.dataplane is not None:
-            return self.dataplane.read(slot, offset, replica)
+            return self.dataplane.read(slot, offset, replica, max_msgs)
         resp = self._engine_call(
             {"type": "engine.read", "slot": slot, "offset": offset,
-             "replica": replica}
+             "replica": replica, "max_msgs": max_msgs}
         )
         return list(resp["messages"]), int(resp["end"])
 
@@ -386,8 +399,10 @@ class BrokerServer:
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
         if t == "engine.read":
+            limit = req.get("max_msgs")
             msgs, end = self.dataplane.read(
-                int(req["slot"]), int(req["offset"]), int(req["replica"])
+                int(req["slot"]), int(req["offset"]), int(req["replica"]),
+                None if limit is None else int(limit),
             )
             return {"ok": True, "messages": msgs, "end": end}
         if t == "engine.read_offset":

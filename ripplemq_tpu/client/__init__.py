@@ -1,0 +1,33 @@
+"""Client SDK: ProducerClient / ConsumerClient.
+
+The public API surface of the reference's mq-common client package
+(reference: mq-common/src/main/java/client/ProducerClient.java:10-15,
+ConsumerClient.java:7): `produce(topic, message)`, `consume(topic)`,
+`close()` — with cached cluster metadata, round-robin partition
+selection, and auto-commit-after-read consumption semantics.
+
+Deliberate upgrades over the reference (documented deviations):
+- Leader addresses come from the advertised broker roster in the
+  metadata response, not from parsing "brokerN" out of hostnames
+  (ProducerClientImpl.getPortModifiedAddress hack, `:101-107`).
+- `produce_batch` amortizes one RPC over many messages (the reference
+  sends exactly one message per RPC — PartitionClient.java:39, called out
+  in SURVEY.md §3.2 as its throughput ceiling).
+- `not_leader` refusals carry a hint; the client follows it and refreshes
+  its cache instead of failing the call.
+- `auto_commit=False` gives at-least-once consumption (the reference is
+  hardwired to commit-after-read at-most-once, ConsumerClientImpl.java:103).
+"""
+
+from ripplemq_tpu.client.metadata import MetadataManager
+from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
+from ripplemq_tpu.client.producer import ProducerClient
+from ripplemq_tpu.client.consumer import ConsumerClient
+
+__all__ = [
+    "MetadataManager",
+    "PartitionSelector",
+    "RoundRobinSelector",
+    "ProducerClient",
+    "ConsumerClient",
+]

@@ -1,0 +1,168 @@
+"""ConsumerClient: consume(topic) with server-tracked offsets.
+
+API parity with the reference's ConsumerClientImpl (reference:
+mq-common/src/main/java/client/ConsumerClientImpl.java:62-117): each
+consume() picks ONE partition round-robin, reads up to max_messages
+(default 10 — `:21`), and with auto_commit=True (the reference's
+hardwired behavior, commit at `:103-109`) immediately commits
+offset + n — at-most-once delivery. auto_commit=False flips to
+at-least-once: process, then call commit() yourself.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
+from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
+from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
+
+DEFAULT_MAX_MESSAGES = 10  # ConsumerClientImpl.java:21
+
+
+class ConsumeError(Exception):
+    pass
+
+
+class ConsumerClient:
+    def __init__(
+        self,
+        bootstrap: list[str],
+        consumer_id: str,
+        transport: Optional[Transport] = None,
+        selector: Optional[PartitionSelector] = None,
+        auto_commit: bool = True,
+        max_messages: int = DEFAULT_MAX_MESSAGES,
+        metadata_refresh_s: float = 10.0,
+        rpc_timeout_s: float = 5.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.2,
+    ) -> None:
+        self._transport = transport if transport is not None else TcpClient()
+        self._owns_transport = transport is None
+        self._selector = selector or RoundRobinSelector()
+        self.consumer_id = consumer_id
+        self.auto_commit = auto_commit
+        self.max_messages = max_messages
+        self._timeout = rpc_timeout_s
+        self._retries = retries
+        self._backoff = retry_backoff_s
+        self._meta = MetadataManager(
+            self._transport,
+            bootstrap,
+            refresh_interval_s=metadata_refresh_s,
+            rpc_timeout_s=rpc_timeout_s,
+        )
+        self._meta.start()
+
+    # ------------------------------------------------------------------ API
+
+    def consume(
+        self,
+        topic: str,
+        partition: Optional[int] = None,
+        max_messages: Optional[int] = None,
+    ) -> list[bytes]:
+        """Read from one (round-robin-chosen) partition of `topic`."""
+        msgs, _, _, _ = self.consume_with_position(topic, partition, max_messages)
+        return msgs
+
+    def consume_with_position(
+        self,
+        topic: str,
+        partition: Optional[int] = None,
+        max_messages: Optional[int] = None,
+    ) -> tuple[list[bytes], int, int, int]:
+        """Like consume(), also returning (messages, partition, offset,
+        next_offset). Manual committers commit `next_offset` — offsets are
+        STORAGE offsets (the broker pads replication rounds for the TPU's
+        alignment), so `offset + len(messages)` is NOT a valid position."""
+        limit = self.max_messages if max_messages is None else max_messages
+        last_err: Optional[str] = None
+        for attempt in range(self._retries):
+            t = self._meta.topic(topic)
+            if t is None:
+                last_err = f"unknown topic {topic!r}"
+                self._refresh_quietly()
+                time.sleep(self._backoff)
+                continue
+            pid = self._selector.select(t) if partition is None else partition
+            addr = self._meta.leader_addr(topic, pid)
+            if addr is None:
+                last_err = f"no leader known for {topic}[{pid}]"
+                self._refresh_quietly()
+                time.sleep(self._backoff)
+                continue
+            try:
+                resp = self._transport.call(
+                    addr,
+                    {"type": "consume", "topic": topic, "partition": pid,
+                     "consumer": self.consumer_id, "max_messages": limit},
+                    timeout=self._timeout,
+                )
+            except RpcError as e:
+                last_err = str(e)
+                self._refresh_quietly()
+                continue
+            if resp.get("ok"):
+                msgs = list(resp["messages"])
+                offset = int(resp["offset"])
+                next_offset = int(resp.get("next_offset", offset))
+                if msgs and self.auto_commit:
+                    self.commit(topic, pid, next_offset)
+                return msgs, pid, offset, next_offset
+            err = str(resp.get("error", ""))
+            last_err = err
+            if err == "not_leader":
+                self._refresh_quietly()
+                continue
+            if "unknown_partition" in err:
+                raise ConsumeError(err)
+            time.sleep(self._backoff)
+        raise ConsumeError(f"consume from {topic} failed: {last_err}")
+
+    def commit(self, topic: str, partition: int, offset: int) -> None:
+        """Commit an absolute offset (replicated through the partition's
+        quorum round, like every offset update)."""
+        last_err: Optional[str] = None
+        for attempt in range(self._retries):
+            addr = self._meta.leader_addr(topic, partition)
+            if addr is None:
+                last_err = f"no leader known for {topic}[{partition}]"
+                self._refresh_quietly()
+                time.sleep(self._backoff)
+                continue
+            try:
+                resp = self._transport.call(
+                    addr,
+                    {"type": "offset.commit", "topic": topic,
+                     "partition": partition, "consumer": self.consumer_id,
+                     "offset": int(offset)},
+                    timeout=self._timeout,
+                )
+            except RpcError as e:
+                last_err = str(e)
+                self._refresh_quietly()
+                continue
+            if resp.get("ok"):
+                return
+            last_err = str(resp.get("error", ""))
+            if last_err == "not_leader":
+                self._refresh_quietly()
+                continue
+            time.sleep(self._backoff)
+        raise ConsumeError(
+            f"offset commit {topic}[{partition}]={offset} failed: {last_err}"
+        )
+
+    def close(self) -> None:
+        self._meta.close()
+        if self._owns_transport:
+            self._transport.close()
+
+    def _refresh_quietly(self) -> None:
+        try:
+            self._meta.refresh()
+        except MetadataError:
+            pass

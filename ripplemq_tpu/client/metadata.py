@@ -1,0 +1,125 @@
+"""Client-side metadata: fetch with retries + cached manager.
+
+Mirrors the reference pair MetadataClient (random bootstrap broker, 3
+retries, 1 s backoff — mq-common/.../MetadataClient.java:34-61) and
+MetadataManager (cache with periodic refresh —
+MetadataManager.java:26-61, refresh cadence ProducerClientImpl.java:18).
+Extends the response with the broker roster so ids resolve to advertised
+addresses.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+from ripplemq_tpu.metadata.models import BrokerInfo, Topic, topics_from_wire
+from ripplemq_tpu.wire.transport import RpcError, Transport
+
+
+class MetadataError(Exception):
+    pass
+
+
+class MetadataManager:
+    """Cached cluster view with background refresh."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        bootstrap: list[str],
+        refresh_interval_s: float = 10.0,
+        fetch_retries: int = 3,
+        retry_backoff_s: float = 1.0,
+        rpc_timeout_s: float = 3.0,
+        seed: Optional[int] = None,
+    ) -> None:
+        if not bootstrap:
+            raise ValueError("need at least one bootstrap address")
+        self._transport = transport
+        self._bootstrap = list(bootstrap)
+        self._retries = fetch_retries
+        self._backoff = retry_backoff_s
+        self._timeout = rpc_timeout_s
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._topics: dict[str, Topic] = {}
+        self._brokers: dict[int, BrokerInfo] = {}
+        self._stop = threading.Event()
+        self._refresh_interval = refresh_interval_s
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        """Initial synchronous fetch, then background refresh (the
+        reference schedules the same loop at 10 s,
+        ProducerClientImpl.java:44-54)."""
+        self.refresh()
+        self._thread = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="metadata-refresh"
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _refresh_loop(self) -> None:
+        while not self._stop.wait(self._refresh_interval):
+            try:
+                self.refresh()
+            except MetadataError:
+                pass  # keep the stale cache; next cycle retries
+
+    def refresh(self) -> None:
+        """Fetch from a random bootstrap broker with retries
+        (MetadataClient.fetchMetadata semantics, `:34-61`)."""
+        last_err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            addr = self._rng.choice(self._bootstrap)
+            try:
+                resp = self._transport.call(
+                    addr, {"type": "meta.topics"}, timeout=self._timeout
+                )
+                if not resp.get("ok"):
+                    raise MetadataError(f"{addr}: {resp.get('error')}")
+                topics = topics_from_wire(resp["topics"])
+                brokers = [BrokerInfo.from_dict(b) for b in resp.get("brokers", [])]
+                with self._lock:
+                    self._topics = {t.name: t for t in topics}
+                    if brokers:
+                        self._brokers = {b.broker_id: b for b in brokers}
+                return
+            except (RpcError, MetadataError, KeyError, ValueError) as e:
+                last_err = e
+                if attempt + 1 < self._retries:
+                    time.sleep(self._backoff)
+        raise MetadataError(f"metadata fetch failed: {last_err}")
+
+    # ------------------------------------------------------------- queries
+
+    def topic(self, name: str) -> Optional[Topic]:
+        with self._lock:
+            return self._topics.get(name)
+
+    def topics(self) -> list[Topic]:
+        with self._lock:
+            return list(self._topics.values())
+
+    def broker_addr(self, broker_id: int) -> Optional[str]:
+        with self._lock:
+            b = self._brokers.get(broker_id)
+            return b.address if b else None
+
+    def leader_addr(self, topic: str, partition_id: int) -> Optional[str]:
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return None
+            a = t.assignment_for(partition_id)
+            if a is None or a.leader is None:
+                return None
+            b = self._brokers.get(a.leader)
+            return b.address if b else None

@@ -1,0 +1,113 @@
+"""ProducerClient: produce(topic, message) against the broker cluster.
+
+API parity with the reference's ProducerClient/Impl (reference:
+mq-common/src/main/java/client/ProducerClientImpl.java:57-99): cached
+metadata, round-robin partition selection, leader-directed send, close().
+Upgrades: real batching (`produce_batch`), not-leader hint following, and
+honest address resolution (see package docstring).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
+from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
+from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
+
+
+class ProduceError(Exception):
+    pass
+
+
+class ProducerClient:
+    def __init__(
+        self,
+        bootstrap: list[str],
+        transport: Optional[Transport] = None,
+        selector: Optional[PartitionSelector] = None,
+        metadata_refresh_s: float = 10.0,
+        rpc_timeout_s: float = 5.0,
+        retries: int = 3,
+        retry_backoff_s: float = 0.2,
+    ) -> None:
+        self._transport = transport if transport is not None else TcpClient()
+        self._owns_transport = transport is None
+        self._selector = selector or RoundRobinSelector()
+        self._timeout = rpc_timeout_s
+        self._retries = retries
+        self._backoff = retry_backoff_s
+        self._meta = MetadataManager(
+            self._transport,
+            bootstrap,
+            refresh_interval_s=metadata_refresh_s,
+            rpc_timeout_s=rpc_timeout_s,
+        )
+        self._meta.start()
+
+    # ------------------------------------------------------------------ API
+
+    def produce(self, topic: str, message: bytes,
+                partition: Optional[int] = None) -> int:
+        """Send one message; returns its assigned absolute offset."""
+        return self.produce_batch(topic, [message], partition=partition)
+
+    def produce_batch(self, topic: str, messages: list[bytes],
+                      partition: Optional[int] = None) -> int:
+        """Send a batch to ONE partition; returns the first assigned
+        offset. The batch rides a single RPC and as few device rounds as
+        its size requires (vs. the reference's one message per RPC,
+        PartitionClient.java:39)."""
+        if not messages:
+            raise ValueError("empty batch")
+        last_err: Optional[str] = None
+        for attempt in range(self._retries):
+            t = self._meta.topic(topic)
+            if t is None:
+                last_err = f"unknown topic {topic!r}"
+                self._refresh_quietly()
+                time.sleep(self._backoff)
+                continue
+            pid = self._selector.select(t) if partition is None else partition
+            addr = self._meta.leader_addr(topic, pid)
+            if addr is None:
+                last_err = f"no leader known for {topic}[{pid}]"
+                self._refresh_quietly()
+                time.sleep(self._backoff)
+                continue
+            try:
+                resp = self._transport.call(
+                    addr,
+                    {"type": "produce", "topic": topic, "partition": pid,
+                     "messages": list(messages)},
+                    timeout=self._timeout,
+                )
+            except RpcError as e:
+                last_err = str(e)
+                self._refresh_quietly()
+                continue
+            if resp.get("ok"):
+                return int(resp["base_offset"])
+            err = str(resp.get("error", ""))
+            last_err = err
+            if err == "not_leader":
+                # Follow the hint next attempt via a metadata refresh; the
+                # hint's addr is also directly usable when present.
+                self._refresh_quietly()
+                continue
+            if "unknown_partition" in err or "bad_request" in err:
+                raise ProduceError(err)  # terminal
+            time.sleep(self._backoff)
+        raise ProduceError(f"produce to {topic} failed: {last_err}")
+
+    def close(self) -> None:
+        self._meta.close()
+        if self._owns_transport:
+            self._transport.close()
+
+    def _refresh_quietly(self) -> None:
+        try:
+            self._meta.refresh()
+        except MetadataError:
+            pass

@@ -11,6 +11,24 @@ from __future__ import annotations
 
 import dataclasses
 
+# Log slot alignment: every committed round advances the log end to a
+# multiple of ALIGN so that the append kernel's DMA windows land on TPU
+# sublane-tile boundaries (Mosaic requires row offsets divisible by the
+# uint8 sublane tile of 8). Consequence: offsets are STORAGE offsets —
+# dense within a round, with up to ALIGN-1 empty padding slots between
+# rounds; the wire protocol therefore always reports `next_offset`
+# explicitly instead of letting clients compute `offset + n` (a documented
+# deviation from the reference's dense-offset arithmetic,
+# ConsumerClientImpl.java:103-109).
+ALIGN = 8
+
+# Bytes reserved at the head of every log row for metadata:
+#   [0:4)  payload length, little-endian int32 (0 = empty/padding row)
+#   [4:8)  Raft term of the writing round, little-endian int32
+# Embedding the header in the row keeps the data plane to ONE array and
+# the append to ONE DMA per (replica, partition) per round.
+ROW_HEADER = 8
+
 
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
@@ -26,7 +44,7 @@ class EngineConfig:
     partitions: int = 8          # P — total partition slots in the program
     replicas: int = 3            # R — replication factor == mesh axis size
     slots: int = 1024            # S — log capacity per partition (entries)
-    slot_bytes: int = 128        # SB — payload bytes per log slot
+    slot_bytes: int = 128        # SB — bytes per log slot (incl. ROW_HEADER)
     max_batch: int = 32          # B — max appended entries per partition/step
     read_batch: int = 32         # RB — max entries per batch read
     max_consumers: int = 64      # C — consumer-offset table width
@@ -39,8 +57,19 @@ class EngineConfig:
             raise ValueError("max_batch cannot exceed slots")
         if self.read_batch > self.slots:
             raise ValueError("read_batch cannot exceed slots")
+        if self.slot_bytes <= ROW_HEADER:
+            raise ValueError(f"slot_bytes must exceed the {ROW_HEADER}-byte row header")
+        if self.max_batch % ALIGN:
+            raise ValueError(f"max_batch must be a multiple of {ALIGN}")
+        if self.slots % ALIGN:
+            raise ValueError(f"slots must be a multiple of {ALIGN}")
 
     @property
     def quorum(self) -> int:
         """Majority of the full membership (Raft quorum)."""
         return self.replicas // 2 + 1
+
+    @property
+    def payload_bytes(self) -> int:
+        """Max message payload per slot (slot minus the row header)."""
+        return self.slot_bytes - ROW_HEADER

@@ -1,11 +1,12 @@
 """Host-side encoding between Python payloads and fixed-shape step tensors.
 
 This is the boundary where variable-length byte-string messages become
-slotted fixed-shape arrays (SURVEY.md §7 "hard parts" #1): payloads are
-padded into `[B, SB]` uint8 slots with a length vector, counts clamp the
-valid prefix. The broker batcher and the test suite share these builders
-so there is exactly one encoder (the reference's equivalent boundary is
-Java serialization of `List<String>` request DTOs,
+slotted fixed-shape arrays (SURVEY.md §7 "hard parts" #1): each payload is
+packed into one `slot_bytes` uint8 row behind an 8-byte header (length +
+round term, little-endian — see core.config.ROW_HEADER). The broker
+batcher and the test suite share these builders so there is exactly one
+encoder (the reference's equivalent boundary is Java serialization of
+`List<String>` request DTOs,
 mq-common/src/main/java/request/partition/MessageAppendRequest.java).
 """
 
@@ -13,8 +14,40 @@ from __future__ import annotations
 
 import numpy as np
 
-from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.config import ROW_HEADER, EngineConfig
 from ripplemq_tpu.core.state import StepInput
+
+
+def pack_rows(
+    cfg: EngineConfig, payloads: list[bytes], term: int
+) -> np.ndarray:
+    """Pack payloads into a [B, SB] block of header-prefixed rows.
+
+    Rows beyond len(payloads) carry length 0 and the round term — they
+    are the round's ALIGN padding and must still hold a valid term (the
+    log-matching check reads the tail row's term, whether or not it holds
+    a payload)."""
+    B, SB = cfg.max_batch, cfg.slot_bytes
+    if len(payloads) > B:
+        raise ValueError(f"{len(payloads)} payloads > max_batch {B}")
+    rows = np.zeros((B, SB), np.uint8)
+    rows[:, 4:8] = np.frombuffer(
+        np.int32(term).tobytes(), np.uint8
+    )  # little-endian term in every row
+    for i, m in enumerate(payloads):
+        if not isinstance(m, (bytes, bytearray, memoryview)):
+            raise TypeError(f"payloads must be bytes, got {type(m).__name__}")
+        m = bytes(m)
+        if not m:
+            raise ValueError("empty messages are not supported (length-0 "
+                             "rows mark alignment padding)")
+        if len(m) > cfg.payload_bytes:
+            raise ValueError(
+                f"payload of {len(m)} bytes > payload_bytes {cfg.payload_bytes}"
+            )
+        rows[i, 0:4] = np.frombuffer(np.int32(len(m)).tobytes(), np.uint8)
+        rows[i, ROW_HEADER : ROW_HEADER + len(m)] = np.frombuffer(m, np.uint8)
+    return rows
 
 
 def build_step_input(
@@ -26,7 +59,7 @@ def build_step_input(
 ) -> StepInput:
     """Build one round's StepInput from plain Python values.
 
-    `appends` maps partition -> payload list (each <= cfg.slot_bytes,
+    `appends` maps partition -> payload list (each <= cfg.payload_bytes,
     at most cfg.max_batch per partition); `offset_updates` maps
     partition -> [(consumer_slot, absolute_offset)]; `leader`/`term` are
     per-partition dicts or one value for all partitions. Raises ValueError
@@ -34,8 +67,20 @@ def build_step_input(
     before building, so a trip here is a bug, not backpressure.
     """
     P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+
+    def _per_partition(value, default):
+        arr = np.full((P,), default, np.int32)
+        if isinstance(value, dict):
+            for p, v in value.items():
+                if not 0 <= p < P:
+                    raise ValueError(f"partition {p} out of range [0, {P})")
+                arr[p] = v
+        else:
+            arr[:] = value
+        return arr
+
+    terms = _per_partition(term, 0)
     entries = np.zeros((P, B, SB), np.uint8)
-    lens = np.zeros((P, B), np.int32)
     counts = np.zeros((P,), np.int32)
     off_slots = np.zeros((P, U), np.int32)
     off_vals = np.zeros((P, U), np.int32)
@@ -44,15 +89,7 @@ def build_step_input(
     for p, msgs in (appends or {}).items():
         if not 0 <= p < P:
             raise ValueError(f"partition {p} out of range [0, {P})")
-        if len(msgs) > B:
-            raise ValueError(f"partition {p}: {len(msgs)} appends > max_batch {B}")
-        for i, m in enumerate(msgs):
-            if len(m) > SB:
-                raise ValueError(
-                    f"partition {p}: payload of {len(m)} bytes > slot_bytes {SB}"
-                )
-            entries[p, i, : len(m)] = np.frombuffer(m, np.uint8)
-            lens[p, i] = len(m)
+        entries[p] = pack_rows(cfg, msgs, int(terms[p]))
         counts[p] = len(msgs)
 
     for p, ups in (offset_updates or {}).items():
@@ -67,30 +104,30 @@ def build_step_input(
             off_vals[p, i] = off
         off_counts[p] = len(ups)
 
-    def _per_partition(value, default):
-        arr = np.full((P,), default, np.int32)
-        if isinstance(value, dict):
-            for p, v in value.items():
-                if not 0 <= p < P:
-                    raise ValueError(f"partition {p} out of range [0, {P})")
-                arr[p] = v
-        else:
-            arr[:] = value
-        return arr
-
     return StepInput(
         entries=entries,
-        lens=lens,
         counts=counts,
         off_slots=off_slots,
         off_vals=off_vals,
         off_counts=off_counts,
         leader=_per_partition(leader, -1),
-        term=_per_partition(term, 0),
+        term=terms,
     )
 
 
 def decode_entries(data, lens, count) -> list[bytes]:
-    """Inverse of the slot encoding for a batch read's (data, lens, count)."""
+    """Messages from a batch read's (rows, lens, count). Length-0 rows are
+    alignment padding, not messages — skipped."""
+    return [m for _, m in decode_entries_with_pos(data, lens, count)]
+
+
+def decode_entries_with_pos(data, lens, count) -> list[tuple[int, bytes]]:
+    """Like decode_entries but yields (row_index, payload) so callers can
+    turn a truncated message list back into a storage offset."""
     data, lens, count = np.asarray(data), np.asarray(lens), int(count)
-    return [bytes(data[i, : lens[i]].tobytes()) for i in range(count)]
+    out = []
+    for i in range(count):
+        n = int(lens[i])
+        if n > 0:
+            out.append((i, bytes(data[i, ROW_HEADER : ROW_HEADER + n].tobytes())))
+    return out

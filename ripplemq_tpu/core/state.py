@@ -9,6 +9,11 @@ mq-broker/src/main/java/metadata/raft/PartitionStateMachine.java:26-27),
 purely in JVM heap; here it is a pytree of device arrays so that
 replication, quorum and apply are tensor ops.
 
+Row format: every log slot is `slot_bytes` of uint8 with an embedded
+8-byte header — payload length then Raft term, both little-endian int32
+(see core.config.ROW_HEADER). One array holds everything the Raft log
+needs, so the append write phase is ONE DMA per (replica, partition).
+
 Axis conventions (see EngineConfig):
   P = partitions, R = replicas, S = log slots, SB = slot bytes,
   B = append batch, C = consumer table width, U = offset-update batch.
@@ -33,12 +38,14 @@ from ripplemq_tpu.core.config import EngineConfig
 class ReplicaState(NamedTuple):
     """Per-replica data-plane state (one replica's view of P partitions)."""
 
-    log_data: jax.Array     # uint8 [P, S, SB] — slotted message payloads
-    log_len: jax.Array      # int32 [P, S]     — payload length per slot (0 = empty)
-    log_term: jax.Array     # int32 [P, S]     — Raft term that wrote each slot
-    log_end: jax.Array      # int32 [P]        — next index to append (log length)
+    log_data: jax.Array     # uint8 [P, S, SB] — slotted rows (header+payload)
+    log_end: jax.Array      # int32 [P]        — next slot to append (ALIGN-padded)
+    last_term: jax.Array    # int32 [P]        — term of the tail row (cached
+    #                         prevLogTerm: maintained by every committed
+    #                         round, travels with resync copies; avoids a
+    #                         per-round row gather)
     current_term: jax.Array  # int32 [P]       — latest term this replica has seen
-    commit: jax.Array       # int32 [P]        — commit index (entries [0, commit) durable)
+    commit: jax.Array       # int32 [P]        — commit index (slots [0, commit) durable)
     offsets: jax.Array      # int32 [P, C]     — replicated consumer offsets
 
 
@@ -50,11 +57,14 @@ class StepInput(NamedTuple):
     (mq-broker/.../MessageAppendRequestProcessor.java:59) is realised by
     the input's sharding layout — XLA broadcasts the batch over the
     replica mesh axis on ICI as part of data distribution.
+
+    `entries` rows are pre-packed with headers (length + round term) by
+    the host encoder; rows at index >= counts[p] carry length 0 but still
+    a valid term (they become the round's alignment padding).
     """
 
-    entries: jax.Array     # uint8 [P, B, SB] — new payloads (leader's batch)
-    lens: jax.Array        # int32 [P, B]     — payload lengths
-    counts: jax.Array      # int32 [P]        — how many of B are valid
+    entries: jax.Array     # uint8 [P, B, SB] — packed rows (leader's batch)
+    counts: jax.Array      # int32 [P]        — how many of B carry payloads
     off_slots: jax.Array   # int32 [P, U]     — consumer-table slots to update
     off_vals: jax.Array    # int32 [P, U]     — new absolute offsets
     off_counts: jax.Array  # int32 [P]        — how many of U are valid
@@ -66,7 +76,7 @@ class StepOutput(NamedTuple):
     """Per-partition results of one round (identical on every replica
     after the psum — the host reads any one replica's copy)."""
 
-    base: jax.Array        # int32 [P] — leader log_end before append (first assigned offset)
+    base: jax.Array        # int32 [P] — leader log_end before append (first assigned slot)
     votes: jax.Array       # int32 [P] — number of replicas that acked the round
     committed: jax.Array   # bool  [P] — quorum reached this round
     commit: jax.Array      # int32 [P] — post-round commit index
@@ -77,9 +87,8 @@ def init_state(cfg: EngineConfig) -> ReplicaState:
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
     return ReplicaState(
         log_data=jnp.zeros((P, S, SB), jnp.uint8),
-        log_len=jnp.zeros((P, S), jnp.int32),
-        log_term=jnp.zeros((P, S), jnp.int32),
         log_end=jnp.zeros((P,), jnp.int32),
+        last_term=jnp.zeros((P,), jnp.int32),
         current_term=jnp.zeros((P,), jnp.int32),
         commit=jnp.zeros((P,), jnp.int32),
         offsets=jnp.zeros((P, C), jnp.int32),
@@ -91,7 +100,6 @@ def empty_input(cfg: EngineConfig) -> StepInput:
     P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
     return StepInput(
         entries=jnp.zeros((P, B, SB), jnp.uint8),
-        lens=jnp.zeros((P, B), jnp.int32),
         counts=jnp.zeros((P,), jnp.int32),
         off_slots=jnp.zeros((P, U), jnp.int32),
         off_vals=jnp.zeros((P, U), jnp.int32),
@@ -99,3 +107,16 @@ def empty_input(cfg: EngineConfig) -> StepInput:
         leader=jnp.full((P,), -1, jnp.int32),
         term=jnp.zeros((P,), jnp.int32),
     )
+
+
+def row_lens(rows: jax.Array) -> jax.Array:
+    """Payload lengths from packed rows' headers: uint8 [..., SB] → int32
+    [...]. Little-endian, matching the host encoder (encode.pack_row)."""
+    hdr = rows[..., 0:4].astype(jnp.int32)
+    return hdr[..., 0] | (hdr[..., 1] << 8) | (hdr[..., 2] << 16) | (hdr[..., 3] << 24)
+
+
+def row_terms(rows: jax.Array) -> jax.Array:
+    """Raft terms from packed rows' headers."""
+    hdr = rows[..., 4:8].astype(jnp.int32)
+    return hdr[..., 0] | (hdr[..., 1] << 8) | (hdr[..., 2] << 16) | (hdr[..., 3] << 24)

@@ -11,8 +11,8 @@ and advances every partition's commit index in a single psum round:
   1. Every replica receives the round's batch (the broadcast over the
      replica axis is the AppendEntries transfer; under SPMD it rides ICI).
   2. A replica *acks* iff it is alive, its log end matches the leader's
-     pre-append log end (the Raft log-matching check) and the leader's
-     term is current.
+     pre-append log end AND its tail term matches the leader's (the full
+     Raft log-matching check) and the leader's term is current.
   3. votes = lax.psum(ack) over the replica axis — the ballot happens
      BEFORE any write (the ack predicate only reads pre-round state).
   4. Rounds are atomic: iff the ballot reached quorum, acking replicas
@@ -21,29 +21,43 @@ and advances every partition's commit index in a single psum round:
      leader/follower logs diverge and repairs them with nextIndex
      backtracking — pointless here, where ballot + write are one fused
      device program.)
-  5. Committed offset updates are scattered into the replicated
-     consumer-offset table (the reference routes these through the same
+  5. Committed consumer-offset updates blend into the replicated offset
+     table in the same round (the reference routes them through the same
      per-partition Raft log — PartitionStateMachine.java:71-77).
+
+The step is split in two phases for the hardware's sake:
+- `replica_control` — everything EXCEPT the log write: acks, ballot,
+  commit bookkeeping, offset-table blend. Cheap [P]-shaped vector ops;
+  runs per replica under vmap (local) or shard_map (SPMD).
+- the log write — one [B, SB] block per committed partition at a
+  variable, ALIGN-aligned offset. This is `ripplemq_tpu.ops.append`
+  (Pallas DMA kernel on TPU; XLA scatter fallback), called once on the
+  full [R, P, S, SB] log by the engine wrappers, NOT per replica.
+
+Each committed round advances log_end to the next ALIGN boundary; padding
+rows carry length 0 and the round's term (core.config.ALIGN rationale).
 
 Rare, branchy transitions (elections, membership, resync after a replica
 returns from the dead) are host-coordinated; the per-step path is
 branch-free so XLA compiles it once per EngineConfig. Leader election's
 vote *counting* does run on device (`vote_step`) as a psum reduction.
-
-The functions take per-replica state and use collectives over the axis
-name "replica"; wrap them with `jax.vmap(..., axis_name="replica")` for a
-single-device simulation or shard the replica axis over a mesh with
-`shard_map` for real multi-chip SPMD (see ripplemq_tpu.parallel.engine).
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ripplemq_tpu.core.config import EngineConfig
-from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput
+from ripplemq_tpu.core.config import ALIGN, EngineConfig
+from ripplemq_tpu.core.state import (
+    ReplicaState,
+    StepInput,
+    StepOutput,
+    row_lens,
+)
 
 AXIS = "replica"
 
@@ -54,46 +68,6 @@ def _bcast_from_leader(value: jax.Array, is_leader: jax.Array) -> jax.Array:
     `value`/`is_leader` are [P]-shaped per-replica arrays."""
     contrib = jnp.where(is_leader, value, jnp.zeros_like(value))
     return lax.psum(contrib, AXIS)
-
-
-def _append_one(
-    log_data, log_len, log_term, entries, lens, count, start, term, do_append
-):
-    """Append up to B entries at `start` into one partition's slotted log.
-
-    Reads a [B, SB] window, blends the valid prefix of the batch in,
-    writes it back. `do_append` disables the write (identity blend) for
-    replicas that did not ack. Shapes: log_data [S, SB], entries [B, SB],
-    lens [B], scalars otherwise.
-
-    dynamic_slice/update clamp the window start so the window fits; when
-    `start > S - B` (tail of the log) the window begins `shift` rows
-    before `start`, so the batch and its validity mask are rolled forward
-    by `shift` to land on the right absolute slots. The caller guarantees
-    start + count <= S, hence count <= B - shift and nothing wraps.
-    """
-    B = entries.shape[0]
-    S = log_data.shape[0]
-    sl_start = jnp.clip(start, 0, S - B)
-    shift = start - sl_start
-    valid = (jnp.arange(B, dtype=jnp.int32) < count) & do_append  # [B]
-    valid = jnp.roll(valid, shift, axis=0)
-    entries = jnp.roll(entries, shift, axis=0)
-    lens = jnp.roll(lens, shift, axis=0)
-
-    window = lax.dynamic_slice(log_data, (sl_start, 0), (B, log_data.shape[1]))
-    window = jnp.where(valid[:, None], entries, window)
-    log_data = lax.dynamic_update_slice(log_data, window, (sl_start, 0))
-
-    len_win = lax.dynamic_slice(log_len, (sl_start,), (B,))
-    len_win = jnp.where(valid, lens, len_win)
-    log_len = lax.dynamic_update_slice(log_len, len_win, (sl_start,))
-
-    term_win = lax.dynamic_slice(log_term, (sl_start,), (B,))
-    term_win = jnp.where(valid, jnp.full((B,), term, jnp.int32), term_win)
-    log_term = lax.dynamic_update_slice(log_term, term_win, (sl_start,))
-
-    return log_data, log_len, log_term
 
 
 def _normalize_alive(alive: jax.Array, P: int, R: int) -> jax.Array:
@@ -109,15 +83,27 @@ def _normalize_alive(alive: jax.Array, P: int, R: int) -> jax.Array:
     return alive
 
 
-def replica_step(
+def _padded_advance(counts: jax.Array) -> jax.Array:
+    """Slots consumed by a round: counts rounded up to ALIGN (0 stays 0)."""
+    return ((counts + ALIGN - 1) // ALIGN) * ALIGN
+
+
+class ControlOut(NamedTuple):
+    out: StepOutput     # per-partition round results (replica-invariant)
+    do_write: jax.Array  # bool [P] — this replica writes the round's block
+
+
+def replica_control(
     cfg: EngineConfig,
     state: ReplicaState,
     inp: StepInput,
     rep_idx: jax.Array,   # int32 scalar — this replica's id on the axis
     alive: jax.Array,     # bool [R] or [P, R] — membership mask (replicated)
     quorum: jax.Array | None = None,  # int32 [P] — per-partition quorum
-) -> tuple[ReplicaState, StepOutput]:
-    """One replication round, from one replica's point of view.
+) -> tuple[ReplicaState, ControlOut]:
+    """One round's control phase from one replica's point of view: the
+    ballot and all scalar-state updates. The returned state has every
+    field advanced EXCEPT `log_data` (the write phase owns that).
 
     `quorum` is per-partition because topics can carry different
     replication factors than the mesh's replica-axis size: a partition
@@ -134,7 +120,7 @@ def replica_step(
     # oversized count would advance log_end past what was written
     # (phantom committed entries).
     counts = jnp.clip(inp.counts, 0, B)
-    inp = inp._replace(counts=counts)
+    advance = _padded_advance(counts)                    # [P]
 
     alive = _normalize_alive(alive, P, R)                # [P, R]
     self_alive = alive[:, rep_idx]                       # [P]
@@ -149,31 +135,31 @@ def replica_step(
     )
 
     # --- 1. leader's pre-append log end ("prevLogIndex" of AppendEntries)
-    # and the term of its last entry ("prevLogTerm").
+    # and the term of its tail row ("prevLogTerm"; cached in state).
     base = _bcast_from_leader(state.log_end, is_leader & self_alive)  # [P]
-    last_idx = jnp.maximum(state.log_end - 1, 0)
-    my_last_term = jnp.where(
-        state.log_end > 0,
-        jnp.take_along_axis(state.log_term, last_idx[:, None], axis=1)[:, 0],
-        0,
+    leader_last_term = _bcast_from_leader(
+        state.last_term, is_leader & self_alive
     )
-    leader_last_term = _bcast_from_leader(my_last_term, is_leader & self_alive)
 
     # --- 2. ack: alive + log-matching + term current. Log matching is the
     # full Raft check — prevLogIndex (log_end == base) AND prevLogTerm:
-    # a replica whose log is the same length but whose tail entry was
-    # written under a different term has a divergent uncommitted suffix
-    # and must NOT ack (it re-enters via host-driven resync). Length alone
-    # would let divergent committed data survive below the commit index.
+    # a replica whose log is the same length but whose tail was written
+    # under a different term has a divergent suffix and must NOT ack (it
+    # re-enters via host-driven resync).
     term_ok = inp.term >= state.current_term
     log_match = (state.log_end == base) & (
-        (base == 0) | (my_last_term == leader_last_term)
+        (base == 0) | (state.last_term == leader_last_term)
     )
-    capacity_ok = base + inp.counts <= S  # backpressure: full partitions never ack
+    # Capacity: the write phase always lands a full B-row window, so the
+    # whole window must fit (up to B-1 tail slots go unused — documented
+    # backpressure bias). Offsets-only rounds (counts == 0) consume no
+    # log space and must keep committing on a full partition: consumers
+    # still need to advance their positions through the backlog.
+    capacity_ok = (counts == 0) | (base + B <= S)
     # A round is ack-worthy if it carries entries OR offset commits: offset
     # commits on idle partitions must still replicate (the reference routes
     # them through the partition Raft log regardless of appends).
-    has_work = (inp.counts > 0) | (inp.off_counts > 0)
+    has_work = (counts > 0) | (inp.off_counts > 0)
     ack = (
         self_alive
         & leader_alive
@@ -183,63 +169,37 @@ def replica_step(
         & has_work
     )  # [P]
 
-    # Followers adopt the leader's (host/election-issued) term.
-    new_current_term = jnp.maximum(state.current_term, inp.term)
-
-    # --- 3. quorum vote FIRST: count acks across the replica axis. The
-    # ack predicate depends only on pre-round state, so the ballot can
-    # precede the write — and therefore gate it.
+    # --- 3. ballot before any write.
     votes = lax.psum(ack.astype(jnp.int32), AXIS)          # [P]
     committed = votes >= quorum                            # [P]
-
-    # --- 4. ATOMIC ROUNDS: writes land only where the round committed.
-    # A failed round (no quorum) leaves no trace on ANY replica — leader
-    # included — so host-level retries can never create divergent or
-    # duplicate entries. This is a deliberate departure from wire Raft
-    # (where a leader appends locally first and followers converge later
-    # via nextIndex backtracking): on TPU the ballot and the write are one
-    # fused program, so the log simply never holds uncommitted entries,
-    # and replica repair reduces to the explicit host resync path.
     do_write = ack & committed                             # [P]
-    log_data, log_len, log_term = jax.vmap(_append_one)(
-        state.log_data,
-        state.log_len,
-        state.log_term,
-        inp.entries,
-        inp.lens,
-        inp.counts,
-        jnp.where(do_write, base, 0),
-        inp.term,
-        do_write,
-    )
-    new_log_end = jnp.where(do_write, base + inp.counts, state.log_end)
 
-    # Commit index == log end on every writing replica; never regresses.
-    commit_target = jnp.where(do_write, base + inp.counts, 0)
+    # --- 4. scalar state advances (atomic with the ballot). wrote_rows
+    # additionally gates the write phase: offsets-only rounds must not pay
+    # the (hottest-op) append DMA for an all-zero window.
+    wrote_rows = do_write & (advance > 0)
+    new_log_end = jnp.where(wrote_rows, base + advance, state.log_end)
+    new_last_term = jnp.where(wrote_rows, inp.term, state.last_term)
+    new_current_term = jnp.maximum(state.current_term, inp.term)
+    commit_target = jnp.where(do_write, base + advance, 0)
     new_commit = jnp.maximum(state.commit, commit_target)
 
-    # --- 5. committed consumer-offset updates (scatter into the table).
-    # The reference replicates offset commits through the same partition
-    # Raft log (ConsumerOffsetUpdateRequestProcessor.java:38-69 →
-    # PartitionStateMachine.java:71-77); here they ride the same quorum
-    # round as the data batch.
+    # --- 5. committed consumer-offset updates: blended (not scattered —
+    # scatters row-serialize on TPU) into the [P, C] table; U is small and
+    # static, so the update unrolls to U masked selects.
     U = cfg.max_offset_updates
-    off_counts = jnp.clip(inp.off_counts, 0, U)
-    off_valid = (jnp.arange(U, dtype=jnp.int32)[None, :] < off_counts[:, None])
-    off_apply = off_valid & do_write[:, None]               # [P, U]
     C = cfg.max_consumers
-    scatter_idx = jnp.where(off_apply, inp.off_slots, C)    # C = out of range → dropped
+    off_counts = jnp.clip(inp.off_counts, 0, U)
+    new_offsets = state.offsets
+    cols = jnp.arange(C, dtype=jnp.int32)[None, :]         # [1, C]
+    for u in range(U):
+        apply_u = do_write & (u < off_counts)              # [P]
+        mask = (inp.off_slots[:, u : u + 1] == cols) & apply_u[:, None]
+        new_offsets = jnp.where(mask, inp.off_vals[:, u : u + 1], new_offsets)
 
-    def _scatter_offsets(offs, idx, vals):
-        return offs.at[idx].set(vals, mode="drop")
-
-    new_offsets = jax.vmap(_scatter_offsets)(state.offsets, scatter_idx, inp.off_vals)
-
-    new_state = ReplicaState(
-        log_data=log_data,
-        log_len=log_len,
-        log_term=log_term,
+    new_state = state._replace(
         log_end=new_log_end,
+        last_term=new_last_term,
         current_term=new_current_term,
         commit=new_commit,
         offsets=new_offsets,
@@ -250,7 +210,32 @@ def replica_step(
         committed=committed,
         commit=lax.pmax(new_commit, AXIS),
     )
-    return new_state, out
+    return new_state, ControlOut(out, wrote_rows)
+
+
+def replica_step(
+    cfg: EngineConfig,
+    state: ReplicaState,
+    inp: StepInput,
+    rep_idx: jax.Array,
+    alive: jax.Array,
+    quorum: jax.Array | None = None,
+) -> tuple[ReplicaState, StepOutput]:
+    """Complete per-replica round: control phase + per-replica XLA append.
+
+    This is the portable all-in-one composition (works under plain vmap on
+    any backend, e.g. the driver's single-chip compile check). The engine
+    wrappers instead run `replica_control` under vmap/shard_map and hand
+    the write phase to the batched Pallas kernel (ops.append) — same
+    semantics, asserted by tests.
+    """
+    new_state, ctl = replica_control(cfg, state, inp, rep_idx, alive, quorum)
+    from ripplemq_tpu.ops.append import append_rows_xla  # local: avoid cycle
+
+    log_data = append_rows_xla(
+        state.log_data, inp.entries, ctl.out.base, ctl.do_write
+    )
+    return new_state._replace(log_data=log_data), ctl.out
 
 
 def vote_step(
@@ -283,12 +268,7 @@ def vote_step(
         False,
     )
 
-    last_idx = jnp.maximum(state.log_end - 1, 0)
-    my_last_term = jnp.where(
-        state.log_end > 0,
-        jnp.take_along_axis(state.log_term, last_idx[:, None], axis=1)[:, 0],
-        0,
-    )
+    my_last_term = state.last_term
     c_end = _bcast_from_leader(state.log_end, is_cand & self_alive)
     c_last_term = _bcast_from_leader(my_last_term, is_cand & self_alive)
 
@@ -308,15 +288,18 @@ def read_batch(
     cfg: EngineConfig,
     state: ReplicaState,
     partition: jax.Array,  # int32 scalar
-    offset: jax.Array,     # int32 scalar — absolute offset to read from
+    offset: jax.Array,     # int32 scalar — storage offset to read from
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Read up to RB *committed* entries of one partition from this replica.
+    """Read up to RB *committed* rows of one partition from this replica.
 
-    Returns (data [RB, SB] uint8, lens [RB] int32, count int32). Serves
-    the consume path; like the reference this is a replica-local read with
-    no extra consensus round (PartitionStateMachine.handleBatchRead:85 —
-    leader-local, no read-index), but unlike the reference it only exposes
-    entries below the commit index.
+    Returns (rows [RB, SB] uint8 — header-prefixed, lens [RB] int32,
+    count int32). `count` counts storage rows (including length-0
+    alignment padding; decode_entries skips those), so the caller's next
+    storage offset is `offset + count`. Serves the consume path; like the
+    reference this is a replica-local read with no extra consensus round
+    (PartitionStateMachine.handleBatchRead:85 — leader-local, no
+    read-index), but unlike the reference it only exposes rows below the
+    commit index.
     """
     RB = cfg.read_batch
     partition = jnp.clip(partition, 0, cfg.partitions - 1)
@@ -328,16 +311,16 @@ def read_batch(
     # (count never exceeds RB - shift, so rolled-in garbage is masked out).
     sl_start = jnp.clip(start, 0, cfg.slots - RB)
     shift = start - sl_start
-    data = lax.dynamic_slice(
+    rows = lax.dynamic_slice(
         state.log_data,
         (partition, sl_start, 0),
         (1, RB, cfg.slot_bytes),
     )[0]
-    lens = lax.dynamic_slice(state.log_len, (partition, sl_start), (1, RB))[0]
-    data = jnp.roll(data, -shift, axis=0)
-    lens = jnp.roll(lens, -shift, axis=0)
+    rows = jnp.roll(rows, -shift, axis=0)
     valid = jnp.arange(RB, dtype=jnp.int32) < count
-    return jnp.where(valid[:, None], data, 0), jnp.where(valid, lens, 0), count
+    rows = jnp.where(valid[:, None], rows, 0)
+    lens = jnp.where(valid, row_lens(rows), 0)
+    return rows, lens, count
 
 
 def read_offset(

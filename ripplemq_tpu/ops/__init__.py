@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot ops, with XLA fallbacks.
+
+- `append` — the log-append write phase: per-partition windowed DMA into
+  the slotted log (the single hottest op in the system; XLA's lowerings
+  are row-serial and ~300-1600x slower at 1k partitions).
+"""
+
+from ripplemq_tpu.ops.append import append_rows, append_rows_xla
+
+__all__ = ["append_rows", "append_rows_xla"]

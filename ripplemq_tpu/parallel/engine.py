@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ripplemq_tpu.core.config import EngineConfig
 from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
 from ripplemq_tpu.core import step as core_step
+from ripplemq_tpu.ops.append import append_rows
 
 try:  # jax>=0.6 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -90,17 +91,23 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
         one = init_state(cfg)
         return jax.tree.map(lambda x: jnp.broadcast_to(x, (R,) + x.shape).copy(), one)
 
-    vstep = jax.vmap(
-        functools.partial(core_step.replica_step, cfg),
+    vctrl = jax.vmap(
+        functools.partial(core_step.replica_control, cfg),
         in_axes=(0, None, 0, None, None),
         axis_name=core_step.AXIS,
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _step_j(state, inp: StepInput, alive, quorum):
-        new_state, out = vstep(state, inp, rep_idx, alive, quorum)
+        # Control phase per replica (vmapped), then ONE batched write phase
+        # on the full [R, P, S, SB] log (Pallas DMA kernel on TPU).
+        new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum)
+        log_data = append_rows(
+            state.log_data, inp.entries, ctl.out.base[0], ctl.do_write
+        )
+        new_state = new_state._replace(log_data=log_data)
         # outputs are replica-invariant after the psum; take replica 0's copy
-        return new_state, jax.tree.map(lambda x: x[0], out)
+        return new_state, jax.tree.map(lambda x: x[0], ctl.out)
 
     def _step(state, inp, alive, quorum=None):
         return _step_j(state, inp, alive,
@@ -150,9 +157,8 @@ def _state_specs(cfg: EngineConfig) -> ReplicaState:
     over "replica", partition axis over "part"."""
     return ReplicaState(
         log_data=P("replica", "part", None, None),
-        log_len=P("replica", "part", None),
-        log_term=P("replica", "part", None),
         log_end=P("replica", "part"),
+        last_term=P("replica", "part"),
         current_term=P("replica", "part"),
         commit=P("replica", "part"),
         offsets=P("replica", "part", None),
@@ -164,7 +170,6 @@ def _input_specs() -> StepInput:
     them over the replica mesh axis (this IS the AppendEntries fan-out)."""
     return StepInput(
         entries=P("part", None, None),
-        lens=P("part", None),
         counts=P("part"),
         off_slots=P("part", None),
         off_vals=P("part", None),
@@ -208,8 +213,15 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     # ---- step -------------------------------------------------------------
     def step_body(state, inp, rep, alive, quorum):
         st = _squeeze(state)          # strip the size-1 replica block dim
-        new_st, out = core_step.replica_step(cfg, st, inp, rep[0], alive, quorum)
-        return _expand(new_st), out   # out is psum-replicated over "replica"
+        new_st, ctl = core_step.replica_control(
+            cfg, st, inp, rep[0], alive, quorum
+        )
+        # Write phase on this device's [1, P_local, S, SB] log block.
+        log_data = append_rows(
+            st.log_data[None], inp.entries, ctl.out.base, ctl.do_write[None]
+        )
+        new_st = new_st._replace(log_data=log_data[0])
+        return _expand(new_st), ctl.out  # out is psum-replicated over "replica"
 
     smapped_step = _shard_map(
         step_body,

@@ -36,3 +36,16 @@ def make_input(
 
 
 decode_read = decode_entries
+
+
+def read_all(fns, state, replica, partition, start=0):
+    """Drain a partition's committed messages by polling storage windows
+    (offsets are storage offsets; rounds are ALIGN-padded)."""
+    out = []
+    offset = start
+    while True:
+        data, lens, count = fns.read(state, replica, partition, offset)
+        if int(count) == 0:
+            return out
+        out.extend(decode_read(data, lens, count))
+        offset += int(count)

@@ -11,7 +11,7 @@ import pytest
 
 from ripplemq_tpu.core.config import EngineConfig
 from ripplemq_tpu.parallel.engine import make_local_fns
-from tests.helpers import small_cfg, make_input, decode_read
+from tests.helpers import small_cfg, make_input, decode_read, read_all
 
 ALL_ALIVE = np.array([True, True, True])
 
@@ -34,7 +34,7 @@ def test_append_commits_with_full_quorum(cfg, fns):
 
     assert int(out.votes[0]) == 3
     assert bool(out.committed[0])
-    assert int(out.commit[0]) == 3
+    assert int(out.commit[0]) == 8  # 3 rows padded to the ALIGN boundary
     assert int(out.base[0]) == 0
     # untouched partition
     assert int(out.commit[1]) == 0
@@ -47,10 +47,9 @@ def test_appends_accumulate_across_rounds(cfg, fns):
     state = fns.init()
     state, out1 = fns.step(state, make_input(cfg, appends={1: [b"a", b"b"]}), ALL_ALIVE)
     state, out2 = fns.step(state, make_input(cfg, appends={1: [b"c"]}), ALL_ALIVE)
-    assert int(out2.base[1]) == 2
-    assert int(out2.commit[1]) == 3
-    data, lens, count = fns.read(state, 0, 1, 1)
-    assert decode_read(data, lens, count) == [b"b", b"c"]
+    assert int(out2.base[1]) == 8   # round 2 starts at the next ALIGN block
+    assert int(out2.commit[1]) == 16
+    assert read_all(fns, state, 0, 1, start=1) == [b"b", b"c"]
 
 
 def test_majority_commits_minority_does_not(cfg, fns):
@@ -66,7 +65,7 @@ def test_majority_commits_minority_does_not(cfg, fns):
     )
     assert int(out.votes[0]) == 1
     assert not bool(out.committed[0])
-    assert int(out.commit[0]) == 1  # unchanged
+    assert int(out.commit[0]) == 8  # unchanged
 
 
 def test_lagging_follower_rejects_then_resyncs(cfg, fns):
@@ -84,9 +83,8 @@ def test_lagging_follower_rejects_then_resyncs(cfg, fns):
     state = fns.resync(state, jnp.int32(0), jnp.int32(2), mask)
     state, out = fns.step(state, make_input(cfg, appends={0: [b"m4"]}), ALL_ALIVE)
     assert int(out.votes[0]) == 3
-    assert int(out.commit[0]) == 4
-    data, lens, count = fns.read(state, 2, 0, 0)
-    assert decode_read(data, lens, count) == [b"m1", b"m2", b"m3", b"m4"]
+    assert int(out.commit[0]) == 24  # three ALIGN-padded rounds
+    assert read_all(fns, state, 2, 0) == [b"m1", b"m2", b"m3", b"m4"]
 
 
 def test_no_leader_no_progress(cfg, fns):
@@ -146,20 +144,20 @@ def test_capacity_backpressure(cfg, fns):
     assert int(out.commit[0]) == cfg.slots
 
 
-def test_exact_fit_batch_near_capacity(cfg, fns):
-    # remaining capacity < max_batch but >= count: the append must land
-    # (capacity check is base+count, not base+max_batch)
+def test_partial_batch_near_capacity(cfg, fns):
+    # The write phase lands a full max_batch window, so the last round in
+    # a partition needs base + max_batch <= slots; a partial batch there
+    # still commits (padded to the boundary), after which the partition
+    # backpressures.
     state = fns.init()
     per_round = cfg.max_batch
     payload = [b"f"] * per_round
     for _ in range(cfg.slots // per_round - 1):
         state, _ = fns.step(state, make_input(cfg, appends={0: payload}), ALL_ALIVE)
-    # log_end = slots - max_batch; append max_batch-3 then exactly 3 more
+    # log_end = slots - max_batch; a partial batch pads to the boundary
     state, out = fns.step(
         state, make_input(cfg, appends={0: payload[: per_round - 3]}), ALL_ALIVE
     )
-    assert bool(out.committed[0])
-    state, out = fns.step(state, make_input(cfg, appends={0: [b"x"] * 3}), ALL_ALIVE)
     assert bool(out.committed[0])
     assert int(out.commit[0]) == cfg.slots
     # and one more must backpressure

@@ -17,25 +17,37 @@ def dp():
     plane.stop()
 
 
+def dp_read_all(dp, slot, replica=0, start=0):
+    msgs, offset = [], start
+    while True:
+        got, nxt = dp.read(slot, offset, replica=replica)
+        if nxt == offset:
+            return msgs
+        msgs.extend(got)
+        offset = nxt
+
+
 def test_append_commits_and_assigns_offsets(dp):
     dp.set_leader(0, 0, 1)
     f1 = dp.submit_append(0, [b"m0", b"m1"])
     f2 = dp.submit_append(0, [b"m2"])
     assert f1.result(timeout=10) == 0
-    assert f2.result(timeout=10) == 2
-    msgs, end = dp.read(0, 0, replica=0)
-    assert msgs == [b"m0", b"m1", b"m2"] and end == 3
-    assert dp.commit_index(0) == 3
+    # f2 either coalesced into f1's round (offset 2) or rode the next
+    # ALIGN-padded round (offset 8) — both are valid storage layouts.
+    assert f2.result(timeout=10) in (2, 8)
+    assert dp_read_all(dp, 0) == [b"m0", b"m1", b"m2"]
+    assert dp.commit_index(0) in (8, 16)
 
 
 def test_many_submitters_coalesce_into_rounds(dp):
     dp.set_leader(1, 2, 1)
     futs = [dp.submit_append(1, [f"m{i}".encode()]) for i in range(50)]
-    offsets = sorted(f.result(timeout=20) for f in futs)
-    assert offsets == list(range(50))
-    msgs, _ = dp.read(1, 0, replica=2)
-    assert len(msgs) == dp.cfg.read_batch  # window-limited
-    assert dp.commit_index(1) == 50
+    offsets = [f.result(timeout=20) for f in futs]
+    # Storage offsets: unique, and reading back yields every message in
+    # submit order (offsets within a round are dense; rounds are padded).
+    assert len(set(offsets)) == 50
+    msgs = dp_read_all(dp, 1, replica=2)
+    assert msgs == [f"m{i}".encode() for i in range(50)]
     # Far fewer device rounds than submits is the whole point.
     assert dp.rounds < 50
 
@@ -95,6 +107,8 @@ def test_validation_errors_are_immediate(dp):
     with pytest.raises(ValueError):
         dp.submit_append(0, [b"x" * 1000]).result(timeout=1)
     with pytest.raises(ValueError):
+        dp.submit_append(0, [b""]).result(timeout=1)  # empty = padding marker
+    with pytest.raises(ValueError):
         dp.submit_append(0, [b"x"] * 100).result(timeout=1)
     with pytest.raises(ValueError):
         dp.submit_offsets(0, [(999, 1)]).result(timeout=1)
@@ -115,10 +129,12 @@ def test_concurrent_submitters_from_threads(dp):
     for t in threads:
         t.join(timeout=30)
     assert len(results) == 20
-    # Offsets within each partition are unique and dense.
+    # Offsets within each partition are unique storage positions, and
+    # every message is durably readable.
     for slot in (0, 1):
-        offs = sorted(v for k, v in results.items() if k % 2 == slot)
-        assert offs == list(range(10))
+        offs = [v for k, v in results.items() if k % 2 == slot]
+        assert len(set(offs)) == 10
+        assert len(dp_read_all(dp, slot)) == 10
 
 
 def test_resync_recovers_lagging_replica(dp):
@@ -131,8 +147,7 @@ def test_resync_recovers_lagging_replica(dp):
     dp.resync(0, 2, [0])
     dp.set_alive(np.ones((dp.cfg.partitions, dp.cfg.replicas), bool))
     dp.submit_append(0, [b"c"]).result(timeout=10)
-    msgs, _ = dp.read(0, 0, replica=2)
-    assert msgs == [b"a", b"b", b"c"]
+    assert dp_read_all(dp, 0, replica=2) == [b"a", b"b", b"c"]
 
 
 def test_partition_full_is_terminal_backpressure():
@@ -161,3 +176,23 @@ def test_consumer_slot_collision_resolved_in_apply():
     m.apply(3, {"op": "register_consumer", "consumer": "a", "slot": 5})  # dup
     assert m.consumer_slot("a") == 0
     assert m.consumer_slot("b") == 1  # collision moved to lowest free
+
+
+def test_offsets_commit_on_full_partition():
+    """Offset commits consume no log space and must keep working after the
+    partition backpressures (consumers still advance through the backlog)."""
+    cfg = small_cfg(slots=8, max_batch=8)
+    dp = DataPlane(cfg, mode="local", max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        dp.submit_append(0, [b"x"] * 8).result(timeout=10)  # log now full
+        assert dp.submit_offsets(0, [(2, 8)]).result(timeout=10) is True
+        assert dp.read_offset(0, 2) == 8
+    finally:
+        dp.stop()
+
+
+def test_oversized_offset_update_rejected_immediately(dp):
+    with pytest.raises(ValueError):
+        dp.submit_offsets(0, [(1, 1)] * 99).result(timeout=1)

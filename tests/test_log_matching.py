@@ -10,7 +10,7 @@ divergent-tail case of Raft §5.3 that length-only matching misses.
 import numpy as np
 
 from ripplemq_tpu.parallel import make_local_fns
-from tests.helpers import decode_read, make_input, small_cfg
+from tests.helpers import decode_read, make_input, read_all, small_cfg
 
 ALL = np.array([True, True, True])
 
@@ -24,7 +24,7 @@ def test_divergent_equal_length_tail_rejected_then_resynced():
     state, out = fns.step(
         state, make_input(cfg, appends={0: [b"a0", b"a1"]}, leader=0, term=1), ALL
     )
-    assert bool(out.committed[0]) and int(out.commit[0]) == 2
+    assert bool(out.committed[0]) and int(out.commit[0]) == 8
 
     # Round 2: leader 0 appends alone (followers masked dead) — uncommitted
     # divergent suffix on replica 0 only.
@@ -42,7 +42,7 @@ def test_divergent_equal_length_tail_rejected_then_resynced():
         make_input(cfg, appends={0: [b"y0", b"y1"]}, leader=1, term=2),
         np.array([False, True, True]),
     )
-    assert bool(out.committed[0]) and int(out.commit[0]) == 4
+    assert bool(out.committed[0]) and int(out.commit[0]) == 16
 
     # Round 4: replica 0 is back. Its log_end (4) equals the leader's, but
     # its tail term is 1 vs the leader's 2 — it must NOT ack.
@@ -52,9 +52,9 @@ def test_divergent_equal_length_tail_rejected_then_resynced():
     assert int(out.votes[0]) == 2  # replicas 1 and 2 only
     assert bool(out.committed[0])
     # Replica 0's own commit must not advance past its consistent prefix.
-    assert int(np.asarray(state.commit)[0, 0]) == 2
+    assert int(np.asarray(state.commit)[0, 0]) == 8
     # Its divergent bytes must never be served as committed.
-    got = decode_read(*fns.read(state, 0, 0, 2))
+    got = read_all(fns, state, 0, 0)
     assert b"x0" not in got and b"x1" not in got
 
     # Resync replica 0 from the leader, after which it acks again.
@@ -65,5 +65,5 @@ def test_divergent_equal_length_tail_rejected_then_resynced():
         state, make_input(cfg, appends={0: [b"w0"]}, leader=1, term=2), ALL
     )
     assert int(out.votes[0]) == 3
-    got = decode_read(*fns.read(state, 0, 0, 0))
+    got = read_all(fns, state, 0, 0)
     assert got == [b"a0", b"a1", b"y0", b"y1", b"z0", b"w0"]

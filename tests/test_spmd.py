@@ -12,7 +12,7 @@ import pytest
 
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh, pick_axes
-from tests.helpers import small_cfg, make_input, decode_read
+from tests.helpers import small_cfg, make_input, decode_read, read_all
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices"
@@ -92,8 +92,7 @@ def test_spmd_vote_and_resync():
     st = spmd.resync(st, jnp.int32(0), jnp.int32(1), mask)
     st, out = spmd.step(st, make_input(cfg, appends={0: [b"c"]}), np.ones(2, bool))
     assert bool(out.committed[0])
-    data, lens, count = spmd.read(st, 1, 0, 0)
-    assert decode_read(data, lens, count) == [b"a", b"c"]
+    assert read_all(spmd, st, 1, 0) == [b"a", b"c"]
 
 
 def test_pick_axes():
