@@ -1,0 +1,274 @@
+// segstore: append-only CRC-framed segment log for the broker's durable
+// log path (built as a shared library, bound from Python via ctypes).
+//
+// The reference delegates durability to JRaft's RocksDB-backed log
+// storage (reference: mq-broker/.../TopicsRaftServer.java:134-136,
+// PartitionRaftServer.java:88-90). Here the device mesh holds the
+// replicated hot state and this store is the host-side durability tier:
+// the controller appends every committed round (and offset commit) as one
+// framed record; recovery replays the records to rebuild device state.
+//
+// Record frame (little-endian):
+//   u32 magic   0x474C5152  ("RQLG")
+//   u8  type    (1 = append round, 2 = offset commits, 3 = meta blob)
+//   u32 slot    (partition slot; 0 for meta)
+//   u32 base    (first storage offset of the round; count for offsets)
+//   u32 len     (payload byte length)
+//   u32 crc32   (CRC-32 of payload, zlib polynomial)
+//   u8  payload[len]
+//
+// Segments rotate at a size threshold: segment-%08d.log in the store dir.
+// A torn tail (partial record / CRC mismatch on the LAST record) is
+// truncated silently at scan time — that is the crash contract: a record
+// is durable once fully written (+ optionally fsynced); a torn write is
+// as if it never happened. Corruption anywhere else stops the scan with
+// an error so operators notice.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fcntl.h>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x474C5152u;
+constexpr size_t kHeader = 4 + 1 + 4 + 4 + 4 + 4;
+
+// CRC-32 (zlib polynomial, reflected), table-driven — matches Python's
+// zlib.crc32 so both implementations interoperate on the same files.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32_of(const uint8_t* data, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xFF; p[1] = (v >> 8) & 0xFF; p[2] = (v >> 16) & 0xFF; p[3] = (v >> 24) & 0xFF;
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+}
+
+std::string seg_name(const std::string& dir, int index) {
+  char buf[64];
+  snprintf(buf, sizeof(buf), "/segment-%08d.log", index);
+  return dir + buf;
+}
+
+struct Store {
+  std::string dir;
+  long segment_bytes;
+  int seg_index = 0;
+  long seg_size = 0;
+  int fd = -1;
+};
+
+struct Scan {
+  std::vector<std::string> files;
+  size_t file_idx = 0;
+  FILE* f = nullptr;
+  bool corrupt = false;
+};
+
+int list_segments(const std::string& dir, std::vector<std::string>* out) {
+  DIR* d = opendir(dir.c_str());
+  if (!d) return -1;
+  std::vector<std::string> names;
+  while (dirent* e = readdir(d)) {
+    std::string n = e->d_name;
+    if (n.rfind("segment-", 0) == 0 && n.size() > 12 &&
+        n.substr(n.size() - 4) == ".log")
+      names.push_back(n);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  for (auto& n : names) out->push_back(dir + "/" + n);
+  return 0;
+}
+
+int open_segment(Store* s) {
+  std::string path = seg_name(s->dir, s->seg_index);
+  s->fd = open(path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (s->fd < 0) return -1;
+  struct stat st;
+  s->seg_size = (fstat(s->fd, &st) == 0) ? (long)st.st_size : 0;
+  return 0;
+}
+
+int write_all(int fd, const uint8_t* p, size_t n) {
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += w;
+    n -= (size_t)w;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* segstore_open(const char* dir, long segment_bytes) {
+  Store* s = new Store;
+  s->dir = dir;
+  s->segment_bytes = segment_bytes > 0 ? segment_bytes : (64L << 20);
+  mkdir(dir, 0755);  // best-effort; may already exist
+  std::vector<std::string> files;
+  if (list_segments(s->dir, &files) == 0 && !files.empty()) {
+    // continue after the highest existing segment index
+    const std::string& last = files.back();
+    size_t pos = last.rfind("segment-");
+    s->seg_index = atoi(last.substr(pos + 8, 8).c_str()) + 1;
+  }
+  if (open_segment(s) != 0) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int segstore_append(void* h, int type, int slot, int base,
+                    const uint8_t* data, int len) {
+  Store* s = static_cast<Store*>(h);
+  if (!s || s->fd < 0 || len < 0) return -1;
+  if (s->seg_size + (long)(kHeader + len) > s->segment_bytes && s->seg_size > 0) {
+    close(s->fd);
+    s->seg_index++;
+    if (open_segment(s) != 0) return -1;
+  }
+  std::vector<uint8_t> frame(kHeader + (size_t)len);
+  put_u32(&frame[0], kMagic);
+  frame[4] = (uint8_t)type;
+  put_u32(&frame[5], (uint32_t)slot);
+  put_u32(&frame[9], (uint32_t)base);
+  put_u32(&frame[13], (uint32_t)len);
+  put_u32(&frame[17], crc32_of(data, (size_t)len));
+  if (len) memcpy(&frame[kHeader], data, (size_t)len);
+  if (write_all(s->fd, frame.data(), frame.size()) != 0) return -1;
+  s->seg_size += (long)frame.size();
+  return 0;
+}
+
+int segstore_flush(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (!s || s->fd < 0) return -1;
+  return fsync(s->fd) == 0 ? 0 : -1;
+}
+
+void segstore_close(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (!s) return;
+  if (s->fd >= 0) {
+    fsync(s->fd);
+    close(s->fd);
+  }
+  delete s;
+}
+
+void* segscan_open(const char* dir) {
+  Scan* sc = new Scan;
+  if (list_segments(dir, &sc->files) != 0) {
+    // missing dir == empty store
+    return sc;
+  }
+  return sc;
+}
+
+// Returns payload length (>= 0) with header fields filled, -1 at end of
+// store, -2 on corruption in the middle of the store, -3 if buf is too
+// small (buflen receives the needed size via *base… no: returns -3 and
+// the caller retries with a bigger buffer of size *len_out).
+int segscan_next(void* h, int* type, int* slot, int* base,
+                 uint8_t* buf, int buflen, int* len_out) {
+  Scan* sc = static_cast<Scan*>(h);
+  if (!sc || sc->corrupt) return -2;
+  for (;;) {
+    if (!sc->f) {
+      if (sc->file_idx >= sc->files.size()) return -1;
+      sc->f = fopen(sc->files[sc->file_idx].c_str(), "rb");
+      if (!sc->f) {
+        sc->corrupt = true;
+        return -2;
+      }
+    }
+    uint8_t hdr[kHeader];
+    size_t got = fread(hdr, 1, kHeader, sc->f);
+    bool last_file = sc->file_idx + 1 == sc->files.size();
+    if (got == 0) {  // clean end of this segment
+      fclose(sc->f);
+      sc->f = nullptr;
+      sc->file_idx++;
+      continue;
+    }
+    if (got < kHeader || get_u32(hdr) != kMagic) {
+      // torn tail of the final segment is the crash contract; anywhere
+      // else it is corruption
+      fclose(sc->f);
+      sc->f = nullptr;
+      if (last_file) {
+        sc->file_idx++;
+        return -1;
+      }
+      sc->corrupt = true;
+      return -2;
+    }
+    uint32_t len = get_u32(hdr + 13);
+    uint32_t crc = get_u32(hdr + 17);
+    *len_out = (int)len;
+    if ((int)len > buflen) {
+      // rewind so the caller can retry with a larger buffer
+      fseek(sc->f, -(long)kHeader, SEEK_CUR);
+      return -3;
+    }
+    got = len ? fread(buf, 1, len, sc->f) : 0;
+    if (got < len || crc32_of(buf, len) != crc) {
+      fclose(sc->f);
+      sc->f = nullptr;
+      if (last_file) {
+        sc->file_idx++;
+        return -1;  // torn/corrupt tail record: truncate
+      }
+      sc->corrupt = true;
+      return -2;
+    }
+    *type = hdr[4];
+    *slot = (int)get_u32(hdr + 5);
+    *base = (int)get_u32(hdr + 9);
+    return (int)len;
+  }
+}
+
+void segscan_close(void* h) {
+  Scan* sc = static_cast<Scan*>(h);
+  if (!sc) return;
+  if (sc->f) fclose(sc->f);
+  delete sc;
+}
+
+}  // extern "C"

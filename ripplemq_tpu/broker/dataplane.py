@@ -28,11 +28,20 @@ from typing import Optional
 
 import numpy as np
 
-from ripplemq_tpu.core.config import EngineConfig
+import struct
+import time
+
+from ripplemq_tpu.core.config import ALIGN, EngineConfig
 from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
-from ripplemq_tpu.core.state import StepInput
+from ripplemq_tpu.core.state import ReplicaState, StepInput, init_state
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh
+from ripplemq_tpu.storage.segment import (
+    REC_APPEND,
+    REC_OFFSETS,
+    SegmentStore,
+    scan_store,
+)
 
 
 class NotCommittedError(Exception):
@@ -71,8 +80,18 @@ class DataPlane:
         mesh=None,
         part_shards: int = 1,
         max_retry_rounds: int = 8,
+        store: Optional[SegmentStore] = None,
+        flush_interval_s: float = 0.05,
     ) -> None:
         self.cfg = cfg
+        # Durability tier: committed rounds are framed into the segment
+        # store from the step thread; fsync happens at most every
+        # `flush_interval_s` (0 = every round). "Committed" therefore
+        # means quorum-replicated on the mesh; durable-on-disk lags by at
+        # most the flush interval (SURVEY.md §7 durability story).
+        self.store = store
+        self.flush_interval_s = flush_interval_s
+        self._last_flush = 0.0
         if mode == "local":
             self.fns = make_local_fns(cfg)
         elif mode == "spmd":
@@ -110,6 +129,8 @@ class DataPlane:
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5)
+        if self.store is not None:
+            self.store.flush()
 
     # ------------------------------------------------------------- control
 
@@ -388,6 +409,7 @@ class DataPlane:
                     base = np.asarray(out.base)
                     committed = np.asarray(out.committed)
                 self.rounds += 1
+                self._persist_round(inp, ctx, base, committed)
                 self._settle(ctx, base, committed)
             except Exception as e:  # the step thread must never die: fail
                 # this round's futures and keep serving (one bad round must
@@ -395,6 +417,34 @@ class DataPlane:
                 self.step_errors += 1
                 if ctx is not None:
                     self._fail_round(ctx, e)
+
+    def _persist_round(self, inp: StepInput, ctx, base, committed) -> None:
+        """Frame this round's committed writes into the segment store."""
+        if self.store is None:
+            return
+        entries = np.asarray(inp.entries)
+        counts = np.asarray(inp.counts)
+        for slot in ctx["appends"]:
+            if not committed[slot] or counts[slot] == 0:
+                continue
+            adv = int(-(-int(counts[slot]) // ALIGN) * ALIGN)
+            payload = entries[slot, :adv].tobytes()
+            self.store.append(REC_APPEND, int(slot), int(base[slot]), payload)
+        for slot, taken_off in ctx["offsets"].items():
+            if not committed[slot]:
+                continue
+            pairs = [p for pend in taken_off for p in pend.payloads]
+            payload = b"".join(struct.pack("<II", s, o) for s, o in pairs)
+            self.store.append(REC_OFFSETS, int(slot), len(pairs), payload)
+        now = time.monotonic()
+        if now - self._last_flush >= self.flush_interval_s:
+            self.store.flush()
+            self._last_flush = now
+
+    def install(self, image: ReplicaState) -> None:
+        """Install a recovered single-replica image (see recover_image)."""
+        with self._device_lock:
+            self._state = self.fns.init_from(image)
 
     def _fail_round(self, ctx, exc: Exception) -> None:
         for taken in ctx["appends"].values():
@@ -463,3 +513,60 @@ class DataPlane:
                 for slot, pend in reversed(requeue_o):
                     self._offsets.setdefault(slot, []).insert(0, pend)
             self._work.set()
+
+
+def recover_image(cfg: EngineConfig, store_dir: str,
+                  use_native: Optional[bool] = None) -> Optional[ReplicaState]:
+    """Replay a segment store into a single-replica state image.
+
+    Returns None if the store holds no records. Only committed rounds were
+    ever persisted, so the rebuilt image is a valid post-commit state for
+    EVERY replica slot (install via DataPlane.install). The replay is the
+    recovery path the reference inherits from JRaft's log replay
+    (SURVEY.md §5 checkpoint) — here it also re-derives the cached
+    last_term from the tail row's embedded header.
+    """
+    P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
+    log_data = np.zeros((P, S, SB), np.uint8)
+    log_end = np.zeros((P,), np.int32)
+    last_term = np.zeros((P,), np.int32)
+    commit = np.zeros((P,), np.int32)
+    offsets = np.zeros((P, C), np.int32)
+    found = False
+    for rec_type, slot, base, payload in scan_store(store_dir, use_native):
+        if not 0 <= slot < P:
+            raise ValueError(
+                f"record for partition {slot} outside engine shape P={P} "
+                f"(store written under a different config?)"
+            )
+        if rec_type == REC_APPEND:
+            if len(payload) % SB:
+                raise ValueError(
+                    f"append payload of {len(payload)} bytes is not a "
+                    f"multiple of slot_bytes {SB}"
+                )
+            rows = np.frombuffer(payload, np.uint8).reshape(-1, SB)
+            n = rows.shape[0]
+            if base + n > S:
+                raise ValueError(f"replayed round exceeds slots ({base}+{n}>{S})")
+            log_data[slot, base : base + n] = rows
+            log_end[slot] = base + n
+            commit[slot] = base + n
+            last_term[slot] = int(
+                np.frombuffer(rows[-1, 4:8].tobytes(), np.int32)[0]
+            )
+        elif rec_type == REC_OFFSETS:
+            for cs, off in struct.iter_unpack("<II", payload):
+                if cs < C:
+                    offsets[slot, cs] = off
+        found = True
+    if not found:
+        return None
+    return ReplicaState(
+        log_data=log_data,
+        log_end=log_end,
+        last_term=last_term,
+        current_term=last_term.copy(),
+        commit=commit,
+        offsets=offsets,
+    )

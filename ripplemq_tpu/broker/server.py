@@ -67,6 +67,7 @@ class BrokerServer:
         engine_mode: str = "local",
         tick_interval_s: float = 0.05,
         duty_interval_s: float = 0.1,
+        data_dir: Optional[str] = None,
     ) -> None:
         self.broker_id = broker_id
         self.config = config
@@ -75,11 +76,35 @@ class BrokerServer:
         self._net = net
         self._duty_interval_s = duty_interval_s
         self._stop = threading.Event()
+        self.data_dir = data_dir
 
         # --- engine (controller only owns a device program) ---
+        # With a data_dir, the controller persists committed rounds to a
+        # segment store and replays them on boot (the role JRaft's storage
+        # URIs play for the reference, TopicsRaftServer.java:134-136 —
+        # which the reference only half-uses: its FSMs never snapshot,
+        # SURVEY.md §5).
         if self.is_controller:
-            self.dataplane = dataplane or DataPlane(config.engine, mode=engine_mode)
-            self._owns_dataplane = dataplane is None
+            if dataplane is not None:
+                self.dataplane = dataplane
+                self._owns_dataplane = False
+            else:
+                store = None
+                if data_dir is not None:
+                    import os
+
+                    from ripplemq_tpu.broker.dataplane import recover_image
+                    from ripplemq_tpu.storage.segment import SegmentStore
+
+                    seg_dir = os.path.join(data_dir, "segments")
+                    image = recover_image(config.engine, seg_dir)
+                    store = SegmentStore(seg_dir)
+                self.dataplane = DataPlane(
+                    config.engine, mode=engine_mode, store=store
+                )
+                if data_dir is not None and image is not None:
+                    self.dataplane.install(image)
+                self._owns_dataplane = True
         else:
             self.dataplane = None
             self._owns_dataplane = False
@@ -94,6 +119,16 @@ class BrokerServer:
 
         # --- control plane ---
         self.manager = PartitionManager(broker_id, config, self.dataplane)
+        persist_fn = None
+        if data_dir is not None:
+            import os
+
+            from ripplemq_tpu.storage.metastore import MetaStore
+
+            self._metastore = MetaStore(os.path.join(data_dir, "meta.bin"))
+            persist_fn = self._metastore.save
+        else:
+            self._metastore = None
         node = RaftNode(
             broker_id,
             config.broker_ids(),
@@ -102,7 +137,12 @@ class BrokerServer:
             restore_fn=self.manager.restore,
             seed=broker_id * 7919,
             compact_threshold=256,
+            persist_fn=persist_fn,
         )
+        if self._metastore is not None:
+            saved = self._metastore.load()
+            if saved is not None:
+                node.restore(saved)
         self.runner = RaftRunner(
             node,
             self.client,

@@ -42,6 +42,7 @@ class LocalEngineFns(NamedTuple):
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_offset: Callable[..., jax.Array]
     resync: Callable[..., ReplicaState]
+    init_from: Callable[[ReplicaState], ReplicaState]  # single-replica image -> [R] state
 
 
 class SpmdEngineFns(NamedTuple):
@@ -51,6 +52,7 @@ class SpmdEngineFns(NamedTuple):
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_offset: Callable[..., jax.Array]
     resync: Callable[..., ReplicaState]
+    init_from: Callable[[ReplicaState], ReplicaState]
     mesh: Mesh
 
 
@@ -145,7 +147,18 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
     def _resync_fn(state, src, dst, part_mask):
         return _resync(cfg, state, src, dst, part_mask)
 
-    return LocalEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn)
+    def _init_from(image: ReplicaState) -> ReplicaState:
+        """Install a recovered single-replica image on every replica slot
+        (all replicas are identical post-commit — only committed rounds
+        are ever persisted)."""
+        import numpy as np
+        return jax.tree.map(
+            lambda x: jnp.asarray(np.broadcast_to(np.asarray(x), (R,) + np.asarray(x).shape)),
+            image,
+        )
+
+    return LocalEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn,
+                          _init_from)
 
 
 # ---------------------------------------------------------------------------
@@ -339,11 +352,17 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         return smapped_resync(state, rep_ids, src, dst, part_mask)
 
     # ---- init -------------------------------------------------------------
-    def _init():
-        one = init_state(cfg)
-        full = jax.tree.map(lambda x: jnp.broadcast_to(x, (R,) + x.shape), one)
+    def _place(one: ReplicaState) -> ReplicaState:
+        full = jax.tree.map(
+            lambda x: jnp.broadcast_to(jnp.asarray(x), (R,) + jnp.asarray(x).shape),
+            one,
+        )
         shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), st_specs,
                                  is_leaf=lambda s: isinstance(s, P))
         return jax.tree.map(jax.device_put, full, shardings)
 
-    return SpmdEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn, mesh)
+    def _init():
+        return _place(init_state(cfg))
+
+    return SpmdEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn,
+                         _place, mesh)

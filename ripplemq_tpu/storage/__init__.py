@@ -1,0 +1,28 @@
+"""Host-side durability tier.
+
+- `segment` — append-only CRC-framed segment store (native C++ via
+  ctypes with a pure-Python fallback writing the identical format) for
+  the committed data-plane log; replay rebuilds device state on restart.
+- `metastore` — atomic file persistence for the metadata Raft's
+  term/vote/log (hostraft persist_fn/restore wiring).
+"""
+
+from ripplemq_tpu.storage.segment import (
+    REC_APPEND,
+    REC_META,
+    REC_OFFSETS,
+    SegmentStore,
+    native_available,
+    scan_store,
+)
+from ripplemq_tpu.storage.metastore import MetaStore
+
+__all__ = [
+    "REC_APPEND",
+    "REC_META",
+    "REC_OFFSETS",
+    "SegmentStore",
+    "native_available",
+    "scan_store",
+    "MetaStore",
+]

@@ -1,0 +1,253 @@
+"""Append-only CRC-framed segment store (ctypes ↔ native/segstore.cpp).
+
+One record per committed replication round (or offset-commit batch or
+metadata blob). The native C++ library owns the hot write path; a pure
+-Python implementation writes the byte-identical format (shared CRC-32 /
+framing), so files are interchangeable and CPU-only environments need no
+toolchain. See native/segstore.cpp for the frame layout and the torn-tail
+crash contract.
+
+The library is compiled on demand from the checked-in source (no network,
+just g++) and cached next to it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import Iterator, Optional
+
+REC_APPEND = 1
+REC_OFFSETS = 2
+REC_META = 3
+
+_MAGIC = 0x474C5152
+_HEADER = struct.Struct("<IBIIII")  # magic, type, slot, base, len, crc
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    with _LIB_LOCK:
+        if _LIB_TRIED:
+            return _LIB
+        _LIB_TRIED = True
+        src_dir = os.path.abspath(_NATIVE_DIR)
+        so_path = os.path.join(src_dir, "libsegstore.so")
+        src_path = os.path.join(src_dir, "segstore.cpp")
+        try:
+            if not os.path.exists(src_path):
+                return None
+            if not os.path.exists(so_path) or (
+                os.path.getmtime(so_path) < os.path.getmtime(src_path)
+            ):
+                subprocess.run(
+                    ["g++", "-O2", "-fPIC", "-std=c++17", "-shared",
+                     "-o", so_path, src_path],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(so_path)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        lib.segstore_open.restype = ctypes.c_void_p
+        lib.segstore_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
+        lib.segstore_append.restype = ctypes.c_int
+        lib.segstore_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.segstore_flush.restype = ctypes.c_int
+        lib.segstore_flush.argtypes = [ctypes.c_void_p]
+        lib.segstore_close.restype = None
+        lib.segstore_close.argtypes = [ctypes.c_void_p]
+        lib.segscan_open.restype = ctypes.c_void_p
+        lib.segscan_open.argtypes = [ctypes.c_char_p]
+        lib.segscan_next.restype = ctypes.c_int
+        lib.segscan_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.segscan_close.restype = None
+        lib.segscan_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+class CorruptStoreError(Exception):
+    """CRC/framing failure in the middle of the store (not a torn tail)."""
+
+
+class SegmentStore:
+    """Writer. `use_native=None` auto-selects the C++ library."""
+
+    def __init__(self, directory: str, segment_bytes: int = 64 << 20,
+                 use_native: Optional[bool] = None) -> None:
+        self.directory = directory
+        self.segment_bytes = segment_bytes
+        os.makedirs(directory, exist_ok=True)
+        lib = _load_native() if use_native in (None, True) else None
+        if use_native is True and lib is None:
+            raise RuntimeError("native segstore requested but unavailable")
+        self._lib = lib
+        self._lock = threading.Lock()
+        if lib is not None:
+            self._handle = lib.segstore_open(
+                directory.encode(), ctypes.c_long(segment_bytes)
+            )
+            if not self._handle:
+                raise OSError(f"segstore_open failed for {directory}")
+            self._file = None
+        else:
+            self._handle = None
+            self._seg_index = self._next_index()
+            self._file = open(self._seg_path(self._seg_index), "ab")
+
+    # -- python fallback helpers --
+    def _seg_path(self, index: int) -> str:
+        return os.path.join(self.directory, f"segment-{index:08d}.log")
+
+    def _next_index(self) -> int:
+        existing = sorted(
+            f for f in os.listdir(self.directory)
+            if f.startswith("segment-") and f.endswith(".log")
+        )
+        if not existing:
+            return 0
+        return int(existing[-1][8:16]) + 1
+
+    # -- API --
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def append(self, rec_type: int, slot: int, base: int, payload: bytes) -> None:
+        with self._lock:
+            if self._handle is not None:
+                rc = self._lib.segstore_append(
+                    self._handle, rec_type, slot, base, payload, len(payload)
+                )
+                if rc != 0:
+                    raise OSError("segstore_append failed")
+                return
+            frame = _HEADER.pack(
+                _MAGIC, rec_type, slot, base, len(payload),
+                zlib.crc32(payload) & 0xFFFFFFFF,
+            ) + payload
+            if (
+                self._file.tell() + len(frame) > self.segment_bytes
+                and self._file.tell() > 0
+            ):
+                self._file.close()
+                self._seg_index += 1
+                self._file = open(self._seg_path(self._seg_index), "ab")
+            self._file.write(frame)
+            self._file.flush()
+
+    def flush(self) -> None:
+        """fsync the active segment (the durability barrier)."""
+        with self._lock:
+            if self._handle is not None:
+                if self._lib.segstore_flush(self._handle) != 0:
+                    raise OSError("segstore_flush failed")
+            else:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle is not None:
+                self._lib.segstore_close(self._handle)
+                self._handle = None
+            elif self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+
+
+def scan_store(
+    directory: str, use_native: Optional[bool] = None
+) -> Iterator[tuple[int, int, int, bytes]]:
+    """Yield (type, slot, base, payload) records in write order. A torn
+    tail record is silently dropped (crash contract); corruption anywhere
+    else raises CorruptStoreError."""
+    if not os.path.isdir(directory):
+        return
+    lib = _load_native() if use_native in (None, True) else None
+    if use_native is True and lib is None:
+        raise RuntimeError("native segstore requested but unavailable")
+    if lib is not None:
+        yield from _scan_native(lib, directory)
+    else:
+        yield from _scan_python(directory)
+
+
+def _scan_native(lib, directory: str):
+    handle = lib.segscan_open(directory.encode())
+    if not handle:
+        return
+    t = ctypes.c_int()
+    slot = ctypes.c_int()
+    base = ctypes.c_int()
+    need = ctypes.c_int()
+    buflen = 1 << 20
+    buf = ctypes.create_string_buffer(buflen)
+    try:
+        while True:
+            rc = lib.segscan_next(handle, ctypes.byref(t), ctypes.byref(slot),
+                                  ctypes.byref(base), buf, buflen,
+                                  ctypes.byref(need))
+            if rc == -3:  # grow the buffer and retry
+                buflen = max(buflen * 2, need.value)
+                buf = ctypes.create_string_buffer(buflen)
+                continue
+            if rc == -1:
+                return
+            if rc == -2:
+                raise CorruptStoreError(f"corrupt record in {directory}")
+            yield t.value, slot.value, base.value, buf.raw[:rc]
+    finally:
+        lib.segscan_close(handle)
+
+
+def _scan_python(directory: str):
+    files = sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("segment-") and f.endswith(".log")
+    )
+    for fi, name in enumerate(files):
+        last_file = fi + 1 == len(files)
+        with open(os.path.join(directory, name), "rb") as f:
+            while True:
+                hdr = f.read(_HEADER.size)
+                if not hdr:
+                    break
+                if len(hdr) < _HEADER.size:
+                    if last_file:
+                        return  # torn tail
+                    raise CorruptStoreError(f"short header in {name}")
+                magic, rec_type, slot, base, length, crc = _HEADER.unpack(hdr)
+                if magic != _MAGIC:
+                    if last_file:
+                        return
+                    raise CorruptStoreError(f"bad magic in {name}")
+                payload = f.read(length)
+                if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    if last_file:
+                        return  # torn/corrupt tail record
+                    raise CorruptStoreError(f"bad record in {name}")
+                yield rec_type, slot, base, payload

@@ -1,0 +1,240 @@
+"""Durability tier: segment store (native C++ + Python), metastore,
+data-plane recovery, broker restart."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from ripplemq_tpu.storage.segment import (
+    REC_APPEND,
+    REC_META,
+    REC_OFFSETS,
+    CorruptStoreError,
+    SegmentStore,
+    native_available,
+    scan_store,
+)
+from ripplemq_tpu.storage.metastore import MetaStore
+
+RECORDS = [
+    (REC_APPEND, 0, 0, b"round-zero-bytes" * 10),
+    (REC_OFFSETS, 3, 2, struct.pack("<IIII", 1, 8, 2, 16)),
+    (REC_APPEND, 1, 8, b"\x00\xff" * 50),
+    (REC_META, 0, 0, b""),
+]
+
+
+def write_all(store):
+    for rec in RECORDS:
+        store.append(*rec)
+    store.flush()
+    store.close()
+
+
+@pytest.mark.parametrize("write_native", [False, True])
+@pytest.mark.parametrize("read_native", [False, True])
+def test_segment_store_roundtrip_cross_impl(tmp_path, write_native, read_native):
+    """Native and Python implementations produce/consume the identical
+    format in every combination."""
+    if (write_native or read_native) and not native_available():
+        pytest.skip("native toolchain unavailable")
+    d = str(tmp_path / "store")
+    write_all(SegmentStore(d, use_native=write_native))
+    got = list(scan_store(d, use_native=read_native))
+    assert got == RECORDS
+
+
+def test_segment_rotation(tmp_path):
+    d = str(tmp_path / "rot")
+    store = SegmentStore(d, segment_bytes=256, use_native=False)
+    recs = [(REC_APPEND, i, i * 8, bytes([i]) * 100) for i in range(10)]
+    for rec in recs:
+        store.append(*rec)
+    store.close()
+    segs = [f for f in os.listdir(d) if f.startswith("segment-")]
+    assert len(segs) > 1, "should have rotated"
+    assert list(scan_store(d)) == recs
+    # Re-open appends to a fresh segment; scan still sees everything.
+    store2 = SegmentStore(d, segment_bytes=256, use_native=False)
+    store2.append(REC_META, 0, 0, b"after-reopen")
+    store2.close()
+    assert list(scan_store(d)) == recs + [(REC_META, 0, 0, b"after-reopen")]
+
+
+def test_torn_tail_is_truncated_mid_corruption_raises(tmp_path):
+    d = str(tmp_path / "torn")
+    write_all(SegmentStore(d, use_native=False))
+    seg = sorted(os.listdir(d))[-1]
+    path = os.path.join(d, seg)
+    # Torn tail: chop bytes off the end -> last record silently dropped.
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-3])
+    got = list(scan_store(d, use_native=False))
+    assert got == RECORDS[:-1]
+    if native_available():
+        assert list(scan_store(d, use_native=True)) == RECORDS[:-1]
+    # Mid-store corruption (flip a payload byte in the FIRST record while
+    # a later segment exists) must raise, not silently truncate.
+    store = SegmentStore(d, segment_bytes=64, use_native=False)
+    store.append(REC_META, 0, 0, b"x" * 100)  # forces later segment
+    store.close()
+    blob = bytearray(open(path, "rb").read())
+    blob[25] ^= 0xFF  # inside record 1's payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CorruptStoreError):
+        list(scan_store(d, use_native=False))
+
+
+def test_metastore_roundtrip_and_atomicity(tmp_path):
+    path = str(tmp_path / "meta" / "meta.bin")
+    ms = MetaStore(path)
+    assert ms.load() is None
+    state = {"term": 4, "voted_for": None, "entries": [{"term": 1, "cmd": {"op": "x"}}],
+             "first_index": 1, "snap_last_index": 0, "snap_last_term": 0,
+             "snap_state": {"topics": [], "live": [0, 1], "consumers": {}}}
+    ms.save(state)
+    assert ms.load() == state
+    # A torn temp file must not shadow the good image.
+    open(path + ".tmp", "wb").write(b"garbage")
+    assert ms.load() == state
+
+
+def test_dataplane_persist_and_recover(tmp_path):
+    from ripplemq_tpu.broker.dataplane import DataPlane, recover_image
+    from tests.helpers import small_cfg
+
+    cfg = small_cfg()
+    d = str(tmp_path / "dp")
+    store = SegmentStore(d)
+    dp = DataPlane(cfg, mode="local", store=store, flush_interval_s=0.0)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 3)
+        dp.set_leader(2, 1, 5)
+        dp.submit_append(0, [b"a", b"b"]).result(timeout=10)
+        dp.submit_append(0, [b"c"]).result(timeout=10)
+        dp.submit_append(2, [b"z1", b"z2", b"z3"]).result(timeout=10)
+        dp.submit_offsets(0, [(1, 8)]).result(timeout=10)
+        from tests.test_dataplane import dp_read_all
+
+        before0 = dp_read_all(dp, 0)
+        before2 = dp_read_all(dp, 2, replica=1)
+        ends = dp.log_ends()
+    finally:
+        dp.stop()
+        store.close()
+
+    image = recover_image(cfg, d)
+    assert image is not None
+    dp2 = DataPlane(cfg, mode="local")
+    dp2.install(image)
+    dp2.start()
+    try:
+        from tests.test_dataplane import dp_read_all
+
+        assert dp_read_all(dp2, 0) == before0 == [b"a", b"b", b"c"]
+        assert dp_read_all(dp2, 2, replica=1) == before2 == [b"z1", b"z2", b"z3"]
+        assert dp2.read_offset(0, 1) == 8
+        np.testing.assert_array_equal(dp2.log_ends(), ends)
+        # The recovered log keeps serving appends (terms/last_term intact).
+        dp2.set_leader(0, 0, 3)
+        dp2.submit_append(0, [b"post-recovery"]).result(timeout=10)
+        assert dp_read_all(dp2, 0)[-1] == b"post-recovery"
+    finally:
+        dp2.stop()
+
+
+def test_recover_rejects_mismatched_config(tmp_path):
+    from ripplemq_tpu.broker.dataplane import DataPlane, recover_image
+    from tests.helpers import small_cfg
+
+    cfg = small_cfg()
+    d = str(tmp_path / "mismatch")
+    store = SegmentStore(d)
+    dp = DataPlane(cfg, mode="local", store=store, flush_interval_s=0.0)
+    dp.start()
+    try:
+        dp.set_leader(3, 0, 1)
+        dp.submit_append(3, [b"x"]).result(timeout=10)
+    finally:
+        dp.stop()
+        store.close()
+    with pytest.raises(ValueError):
+        recover_image(small_cfg(partitions=2), d)  # partition 3 out of shape
+
+
+def test_broker_restart_recovers_messages_and_metadata(tmp_path):
+    """Kill every broker; restart from data dirs; committed messages and
+    consumer offsets survive."""
+    import time
+
+    from ripplemq_tpu.broker.server import BrokerServer
+    from ripplemq_tpu.wire import InProcNetwork
+    from tests.broker_harness import make_config
+
+    config = make_config(3, metadata_election_timeout_s=0.6)
+    dirs = {i: str(tmp_path / f"broker-{i}") for i in range(3)}
+
+    def boot(net):
+        brokers = {
+            i: BrokerServer(i, config, net=net, tick_interval_s=0.02,
+                            duty_interval_s=0.05, data_dir=dirs[i])
+            for i in range(3)
+        }
+        for b in brokers.values():
+            b.start()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            ts = brokers[0].manager.get_topics()
+            if ts and all(a.leader is not None for t in ts for a in t.assignments):
+                return brokers
+            time.sleep(0.05)
+        raise AssertionError("no leaders")
+
+    net = InProcNetwork()
+    brokers = boot(net)
+    client = net.client("c")
+    leader = brokers[0].manager.leader_of(("topic1", 0))
+    resp = client.call(brokers[leader].addr,
+                       {"type": "produce", "topic": "topic1", "partition": 0,
+                        "messages": [b"durable-1", b"durable-2"]}, timeout=10)
+    assert resp["ok"], resp
+    resp = client.call(brokers[leader].addr,
+                       {"type": "consume", "topic": "topic1", "partition": 0,
+                        "consumer": "g"}, timeout=10)
+    assert resp["messages"] == [b"durable-1", b"durable-2"]
+    resp = client.call(brokers[leader].addr,
+                       {"type": "offset.commit", "topic": "topic1",
+                        "partition": 0, "consumer": "g",
+                        "offset": resp["next_offset"]}, timeout=10)
+    assert resp["ok"]
+    time.sleep(0.2)  # let the flush interval pass
+    for b in brokers.values():
+        b.stop()
+
+    # Full cluster restart from disk.
+    net2 = InProcNetwork()
+    brokers2 = boot(net2)
+    client2 = net2.client("c2")
+    try:
+        leader2 = brokers2[0].manager.leader_of(("topic1", 0))
+        # Offset survived: consuming as "g" sees nothing new...
+        resp = client2.call(brokers2[leader2].addr,
+                            {"type": "consume", "topic": "topic1",
+                             "partition": 0, "consumer": "g"}, timeout=10)
+        assert resp["ok"] and resp["messages"] == [], resp
+        # ...while a fresh consumer replays the durable messages.
+        resp = client2.call(brokers2[leader2].addr,
+                            {"type": "consume", "topic": "topic1",
+                             "partition": 0, "consumer": "fresh"}, timeout=10)
+        assert resp["messages"] == [b"durable-1", b"durable-2"], resp
+        # And the partition keeps accepting appends after recovery.
+        resp = client2.call(brokers2[leader2].addr,
+                            {"type": "produce", "topic": "topic1",
+                             "partition": 0, "messages": [b"post"]}, timeout=10)
+        assert resp["ok"], resp
+    finally:
+        for b in brokers2.values():
+            b.stop()
