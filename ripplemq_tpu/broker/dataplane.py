@@ -279,11 +279,16 @@ class DataPlane:
             next_offset = offset + count
         return [m for _, m in with_pos], next_offset
 
-    def read_offset(self, slot: int, consumer_slot: int) -> int:
+    def read_offset(self, slot: int, consumer_slot: int, replica: int = 0) -> int:
+        """Committed consumer offset as seen by `replica`. Callers should
+        pass the partition leader's replica slot: offset commits apply only
+        on acking replicas, and the leader always acks a committed round —
+        replica 0 may be masked dead and hold a stale table."""
         with self._device_lock:
             return int(
                 self.fns.read_offset(
-                    self._state, np.int32(0), np.int32(slot), np.int32(consumer_slot)
+                    self._state, np.int32(replica), np.int32(slot),
+                    np.int32(consumer_slot),
                 )
             )
 

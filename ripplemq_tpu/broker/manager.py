@@ -205,7 +205,11 @@ class PartitionManager:
 
     def _resync_slots(self, came_alive: np.ndarray) -> None:
         """Group newly-alive (partition, replica-slot) cells by (leader
-        slot, dst slot) and issue batched resyncs."""
+        slot, dst slot) and issue batched resyncs. Partitions that are
+        leaderless at this point are picked up by the periodic
+        `plan_repairs` pass once they elect (a slot that comes alive while
+        leaderless lags the eventual leader by log_end, which is exactly
+        what plan_repairs keys on)."""
         pairs: dict[tuple[int, int], list[int]] = {}
         for key, slot in self.slot_map.items():
             assign = self.assignment_of(key)
@@ -219,6 +223,46 @@ class PartitionManager:
                     pairs.setdefault((src, r), []).append(slot)
         for (src, dst), slots in pairs.items():
             self.dataplane.resync(src, dst, slots)
+
+    def plan_repairs(
+        self, log_ends: Optional[np.ndarray] = None
+    ) -> dict[tuple[int, int], list[int]]:
+        """Controller lag repair: alive replica slots whose log end trails
+        their partition leader's, grouped into batched (src, dst) resyncs.
+        Run periodically from the controller duty — this is the documented
+        'lag repair' pass, and it covers the cases the event-driven
+        `_resync_slots` cannot: slots that came alive while the partition
+        was leaderless, and followers that missed rounds committed by a
+        quorum that excluded them. Safe because atomic ballot-before-write
+        rounds guarantee a lagging replica holds a strict prefix of the
+        leader's log (never diverged), so a full-slot copy only moves it
+        forward. `log_ends` lets the duty loop share one [R, P] device
+        snapshot between this and plan_elections per tick."""
+        with self.lock:
+            if self.dataplane is None:
+                return {}
+            if log_ends is None:
+                log_ends = self.dataplane.log_ends()  # [R, P]
+            R = self.dataplane.cfg.replicas
+            live = set(self.live)
+            pairs: dict[tuple[int, int], list[int]] = {}
+            for t in self.topics:
+                for a in t.assignments:
+                    slot = self.slot_map.get((t.name, a.partition_id))
+                    if slot is None or a.leader is None or a.leader not in live:
+                        continue
+                    if a.leader not in a.replicas:
+                        continue
+                    src = a.replicas.index(a.leader)
+                    if src >= R:
+                        continue
+                    src_end = int(log_ends[src, slot])
+                    for r, b in enumerate(a.replicas[:R]):
+                        if r == src or b not in live:
+                            continue
+                        if int(log_ends[r, slot]) < src_end:
+                            pairs.setdefault((src, r), []).append(slot)
+            return pairs
 
     # ------------------------------------------------------------- queries
 
@@ -291,7 +335,9 @@ class PartitionManager:
 
     # --------------------------------------------- controller duty logic
 
-    def plan_elections(self) -> tuple[dict[int, tuple[int, int]], dict[int, dict]]:
+    def plan_elections(
+        self, log_ends: Optional[np.ndarray] = None
+    ) -> tuple[dict[int, tuple[int, int]], dict[int, dict]]:
         """Controller: find partitions whose leader is unknown or dead and
         pick candidates (the alive replica with the longest log — vote_step
         still enforces log-up-to-dateness on device). Returns
@@ -300,7 +346,8 @@ class PartitionManager:
         with self.lock:
             if self.dataplane is None:
                 return {}, {}
-            log_ends = self.dataplane.log_ends()          # [R, P]
+            if log_ends is None:
+                log_ends = self.dataplane.log_ends()      # [R, P]
             device_terms = self.dataplane.current_terms() # [P]
             live = set(self.live)
             cands: dict[int, tuple[int, int]] = {}

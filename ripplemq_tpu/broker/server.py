@@ -288,6 +288,15 @@ class BrokerServer:
         return slot, None
 
     def _handle_produce(self, req: dict) -> dict:
+        """Produce semantics are at-least-once: a batch larger than
+        max_batch is split into pipelined rounds, and some rounds can fail
+        while others commit (a failed middle round leaves a gap). ALL
+        pipelined rounds are drained before responding; on any failure the
+        error carries the total number of messages that did commit in
+        `committed`, so a client that retries the whole batch knows it is
+        duplicating that many (the reference has the same window one
+        message at a time — its closure can fail after the Raft entry
+        committed, MessageAppendRequestProcessor.java:36-67)."""
         key = group_key(req["topic"], req["partition"])
         slot, refusal = self._check_partition(key)
         if refusal:
@@ -296,12 +305,25 @@ class BrokerServer:
         if not isinstance(messages, list) or not messages:
             return {"ok": False, "error": "bad_request: empty messages"}
         B = self.config.engine.max_batch
-        futs = [
-            self._engine_append(slot, messages[i : i + B])
-            for i in range(0, len(messages), B)
-        ]
-        bases = [f() for f in futs]
-        return {"ok": True, "base_offset": bases[0], "count": len(messages)}
+        chunks = [messages[i : i + B] for i in range(0, len(messages), B)]
+        futs = [self._engine_append(slot, chunk) for chunk in chunks]
+        base0 = None
+        committed = 0
+        first_err: Optional[Exception] = None
+        for chunk, fut in zip(chunks, futs):
+            try:
+                base = fut()
+            except NotCommittedError as e:
+                if first_err is None:
+                    first_err = e
+                continue
+            if base0 is None and first_err is None:
+                base0 = base
+            committed += len(chunk)
+        if first_err is not None:
+            return {"ok": False, "error": f"not_committed: {first_err}",
+                    "committed": committed}
+        return {"ok": True, "base_offset": base0, "count": committed}
 
     def _handle_consume(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
@@ -314,7 +336,10 @@ class BrokerServer:
         replica = self.manager.replica_slot(key, self.broker_id)
         if replica is None:
             replica = 0  # leader not in replicas: metadata race; read slot 0
-        offset = self._engine_read_offset(slot, cslot)
+        # Read the offset from the leader's own replica slot too: replica
+        # 0 may be masked dead and hold a stale offset table (commits only
+        # apply on acking replicas).
+        offset = self._engine_read_offset(slot, cslot, replica)
         limit = req.get("max_messages")
         msgs, next_offset = self._engine_read(
             slot, offset, replica, None if limit is None else int(limit)
@@ -409,11 +434,12 @@ class BrokerServer:
         )
         return list(resp["messages"]), int(resp["end"])
 
-    def _engine_read_offset(self, slot: int, cslot: int) -> int:
+    def _engine_read_offset(self, slot: int, cslot: int, replica: int = 0) -> int:
         if self.dataplane is not None:
-            return self.dataplane.read_offset(slot, cslot)
+            return self.dataplane.read_offset(slot, cslot, replica)
         resp = self._engine_call(
-            {"type": "engine.read_offset", "slot": slot, "cslot": cslot}
+            {"type": "engine.read_offset", "slot": slot, "cslot": cslot,
+             "replica": replica}
         )
         return int(resp["offset"])
 
@@ -447,7 +473,8 @@ class BrokerServer:
             return {"ok": True, "messages": msgs, "end": end}
         if t == "engine.read_offset":
             return {"ok": True, "offset": self.dataplane.read_offset(
-                int(req["slot"]), int(req["cslot"]))}
+                int(req["slot"]), int(req["cslot"]),
+                int(req.get("replica", 0)))}
         if t == "engine.offsets":
             fut = self.dataplane.submit_offsets(
                 int(req["slot"]), [(int(s), int(o)) for s, o in req["updates"]]
@@ -482,10 +509,17 @@ class BrokerServer:
     def _controller_duty(self) -> None:
         if self.dataplane is None:
             return
-        cands, drafts = self.manager.plan_elections()
-        if not cands:
-            return
-        winners = self.dataplane.elect(cands)
-        for slot, won in winners.items():
-            if won:
-                self.propose_cmd(drafts[slot], retries=1)
+        # One [R, P] log-ends snapshot per tick, shared by both planners
+        # (elections don't move log ends, so the snapshot stays valid).
+        log_ends = self.dataplane.log_ends()
+        cands, drafts = self.manager.plan_elections(log_ends)
+        if cands:
+            winners = self.dataplane.elect(cands)
+            for slot, won in winners.items():
+                if won:
+                    self.propose_cmd(drafts[slot], retries=1)
+        # Periodic lag repair: catch up alive followers that trail their
+        # leader (covers post-election catch-up and slots that came alive
+        # while the partition was leaderless).
+        for (src, dst), slots in self.manager.plan_repairs(log_ends).items():
+            self.dataplane.resync(src, dst, slots)

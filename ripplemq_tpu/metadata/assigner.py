@@ -10,6 +10,17 @@ its semantics:
   factor with the least-loaded live broker that does not already hold the
   partition (`:81-89`, `:103-115`). Load = number of partition replicas a
   broker holds across the whole new assignment.
+- **Slot stability (deviation, required by the device engine)**: the
+  position of a broker in the `replicas` tuple IS its physical replica
+  slot in the device state ([R] axis) — per-slot logs never move when the
+  assignment changes. A surviving broker therefore KEEPS its position;
+  dead brokers leave holes that replacements fill in place. (The
+  reference can compact the list freely because each JRaft group carries
+  its own identity-keyed log.) Without this, a reassignment would remap a
+  retained leader onto a stale physical slot and a quorum of stale slots
+  could commit at a stale base. Replacement brokers inherit a stale
+  physical slot by design: they flip that slot dead→alive, which triggers
+  the controller's resync-from-leader before the slot serves.
 - **Leader retention**: a previous leader that survives in the replica set
   stays leader; otherwise the leader becomes unknown until the partition
   group elects and advertises one (the reference clears it the same way
@@ -46,10 +57,12 @@ def assign_partitions(
     prev_by_name = {t.name: t for t in (previous or [])}
     load: dict[int, int] = {b: 0 for b in live}
 
-    # Pass 1: survivors — count retained replicas into the load table first
-    # so top-up decisions see the true load (the reference builds load the
-    # same way, PartitionAssigner.java:50-67).
-    survivors: dict[tuple[str, int], list[int]] = {}
+    # Pass 1: survivors — keep alive brokers in their replica-slot
+    # POSITIONS (dead brokers become None holes), counting retained
+    # replicas into the load table first so top-up decisions see the true
+    # load (the reference builds load the same way,
+    # PartitionAssigner.java:50-67).
+    survivors: dict[tuple[str, int], list[int | None]] = {}
     prev_leaders: dict[tuple[str, int], int | None] = {}
     prev_terms: dict[tuple[str, int], int] = {}
     for topic in topics:
@@ -62,33 +75,42 @@ def assign_partitions(
         prev_assigns = (
             {a.partition_id: a for a in prev_topic.assignments} if prev_topic else {}
         )
+        rf = topic.replication_factor
         for pid in range(topic.partitions):
             prev_assign = prev_assigns.get(pid)
-            kept = [b for b in (prev_assign.replicas if prev_assign else ()) if b in load]
-            kept = kept[: topic.replication_factor]
-            for b in kept:
-                load[b] += 1
-            survivors[(topic.name, pid)] = kept
+            prev_replicas = prev_assign.replicas if prev_assign else ()
+            slots: list[int | None] = [
+                b if b in load else None for b in prev_replicas[:rf]
+            ]
+            slots += [None] * (rf - len(slots))
+            for b in slots:
+                if b is not None:
+                    load[b] += 1
+            survivors[(topic.name, pid)] = slots
             prev_leaders[(topic.name, pid)] = prev_assign.leader if prev_assign else None
             prev_terms[(topic.name, pid)] = prev_assign.term if prev_assign else 0
 
-    # Pass 2: top up each partition to RF with the least-loaded live broker
-    # not already holding it (ties → lowest broker id).
+    # Pass 2: fill each hole in place with the least-loaded live broker not
+    # already holding the partition (ties → lowest broker id).
     out: list[Topic] = []
     for topic in topics:
         assignments: list[PartitionAssignment] = []
         for pid in range(topic.partitions):
-            replicas = list(survivors[(topic.name, pid)])
-            while len(replicas) < topic.replication_factor:
-                candidates = [b for b in live if b not in replicas]
-                pick = min(candidates, key=lambda b: (load[b], b))
-                replicas.append(pick)
+            slots = list(survivors[(topic.name, pid)])
+            held = {b for b in slots if b is not None}
+            for i, b in enumerate(slots):
+                if b is not None:
+                    continue
+                candidates = [c for c in live if c not in held]
+                pick = min(candidates, key=lambda c: (load[c], c))
+                slots[i] = pick
+                held.add(pick)
                 load[pick] += 1
             prev_leader = prev_leaders[(topic.name, pid)]
-            leader = prev_leader if prev_leader in replicas else None
+            leader = prev_leader if prev_leader in slots else None
             assignments.append(
                 PartitionAssignment(
-                    pid, tuple(replicas), leader, prev_terms[(topic.name, pid)]
+                    pid, tuple(slots), leader, prev_terms[(topic.name, pid)]
                 )
             )
         out.append(topic.with_assignments(tuple(assignments)))

@@ -119,6 +119,19 @@ def encode(v) -> bytes:
     return out.getvalue()
 
 
+def _read_length(buf: memoryview, pos: int) -> tuple[int, int]:
+    """Decode a length/count prefix, rejecting malformed frames cleanly: a
+    negative decoded length would make buf[pos:pos+n] silently yield an
+    empty slice and move pos BACKWARDS, and an oversized one would loop on
+    garbage — both must be decode errors, not confusing downstream ones."""
+    n, pos = _read_varint(buf, pos)
+    if n < 0:
+        raise ValueError(f"negative length {n} at {pos}")
+    if n > len(buf) - pos:
+        raise ValueError(f"length {n} at {pos} exceeds remaining buffer")
+    return n, pos
+
+
 def _decode_at(buf: memoryview, pos: int):
     tag = bytes(buf[pos : pos + 1])
     pos += 1
@@ -133,23 +146,23 @@ def _decode_at(buf: memoryview, pos: int):
     if tag == _FLOAT:
         return struct.unpack(">d", buf[pos : pos + 8])[0], pos + 8
     if tag == _STR:
-        n, pos = _read_varint(buf, pos)
+        n, pos = _read_length(buf, pos)
         return str(buf[pos : pos + n], "utf-8"), pos + n
     if tag == _BYTES:
-        n, pos = _read_varint(buf, pos)
+        n, pos = _read_length(buf, pos)
         return bytes(buf[pos : pos + n]), pos + n
     if tag == _LIST:
-        n, pos = _read_varint(buf, pos)
+        n, pos = _read_length(buf, pos)
         items = []
         for _ in range(n):
             item, pos = _decode_at(buf, pos)
             items.append(item)
         return items, pos
     if tag == _DICT:
-        n, pos = _read_varint(buf, pos)
+        n, pos = _read_length(buf, pos)
         d = {}
         for _ in range(n):
-            klen, pos = _read_varint(buf, pos)
+            klen, pos = _read_length(buf, pos)
             k = str(buf[pos : pos + klen], "utf-8")
             pos += klen
             d[k], pos = _decode_at(buf, pos)

@@ -196,3 +196,66 @@ def test_offsets_commit_on_full_partition():
 def test_oversized_offset_update_rejected_immediately(dp):
     with pytest.raises(ValueError):
         dp.submit_offsets(0, [(1, 1)] * 99).result(timeout=1)
+
+
+def test_plan_repairs_catches_slot_revived_while_leaderless():
+    """A replica slot that comes alive while its partition is leaderless
+    gets no event-driven resync (there is no leader to copy from). The
+    periodic plan_repairs pass must catch it up once a leader exists —
+    without it the slot would stay permanently stale and silently reduce
+    fault tolerance (ADVICE round 1, manager.py:213)."""
+    from ripplemq_tpu.broker.manager import OP_SET_LEADER, OP_SET_TOPICS, PartitionManager
+    from ripplemq_tpu.metadata.models import PartitionAssignment, Topic, topics_to_wire
+    from tests.broker_harness import make_config
+
+    config = make_config(3)
+    dp = DataPlane(config.engine, mode="local", max_retry_rounds=3)
+    dp.start()
+    try:
+        m = PartitionManager(0, config, dp)
+
+        def topics_with(leader, term):
+            return topics_to_wire([
+                t.with_assignments(tuple(
+                    PartitionAssignment(pid, (0, 1, 2), leader, term)
+                    for pid in range(t.partitions)
+                ))
+                for t in config.topics
+            ])
+
+        # Healthy cluster, leader broker 0 everywhere; commit a round.
+        m.apply(1, {"op": OP_SET_TOPICS, "topics": topics_with(0, 1),
+                    "live": [0, 1, 2]})
+        slot = m.slot_of(("topic1", 0))
+        assert dp.submit_append(slot, [b"r1a", b"r1b"]).result(timeout=10) == 0
+
+        # Broker 2 dies; the quorum of {0, 1} keeps committing.
+        m.apply(2, {"op": OP_SET_TOPICS, "topics": topics_with(0, 1),
+                    "live": [0, 1]})
+        dp.submit_append(slot, [b"r2"]).result(timeout=10)
+        ends = dp.log_ends()
+        assert ends[2, slot] < ends[0, slot]  # replica 2 is stale
+
+        # Leader lost too: partition goes leaderless, THEN broker 2
+        # revives. came-alive resync is skipped (no leader to copy from).
+        m.apply(3, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
+                    "leader": None, "term": 1})
+        m.apply(4, {"op": OP_SET_TOPICS, "topics": topics_with(None, 1),
+                    "live": [0, 1, 2]})
+        assert m.plan_repairs() == {}  # leaderless: nothing to plan yet
+        ends = dp.log_ends()
+        assert ends[2, slot] < ends[0, slot]  # still stale
+
+        # Election lands: now the periodic repair pass must plan a resync.
+        m.apply(5, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
+                    "leader": 0, "term": 2})
+        repairs = m.plan_repairs()
+        assert any(slot in slots for (_, d), slots in repairs.items() if d == 2)
+        for (src, dst), slots in repairs.items():
+            dp.resync(src, dst, slots)
+        ends = dp.log_ends()
+        assert ends[2, slot] == ends[0, slot]
+        assert dp_read_all(dp, slot, replica=2) == [b"r1a", b"r1b", b"r2"]
+        assert m.plan_repairs() == {}  # converged
+    finally:
+        dp.stop()

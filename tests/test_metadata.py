@@ -94,6 +94,51 @@ def test_assign_leader_retained_or_cleared():
             assert a.leader is None
 
 
+def test_assign_preserves_replica_slot_positions():
+    """A surviving broker must keep its INDEX in the replicas tuple: the
+    index is its physical replica slot in the device state, and per-slot
+    logs never move on reassignment. The replacement for a dead broker
+    must occupy the dead broker's position (it inherits that stale
+    physical slot and gets resynced), not shift everyone else."""
+    topics = mk_topics([("t", 4, 3)])
+    first = assign_partitions(topics, [0, 1, 2, 3, 4])
+    for victim in [0, 1, 2, 3, 4]:
+        live = [b for b in [0, 1, 2, 3, 4] if b != victim]
+        second = assign_partitions(topics, live, previous=first)
+        for t1, t2 in zip(first, second):
+            for a1, a2 in zip(t1.assignments, t2.assignments):
+                assert len(a2.replicas) == len(a1.replicas)
+                for i, b in enumerate(a1.replicas):
+                    if b != victim:
+                        assert a2.replicas[i] == b, (
+                            f"survivor {b} moved from slot {i} "
+                            f"to {a2.replicas.index(b)}"
+                        )
+                    else:
+                        assert a2.replicas[i] != victim
+
+
+def test_assign_positions_stable_under_churn():
+    """Position stability holds across arbitrary membership churn, not
+    just single failures."""
+    rng = random.Random(13)
+    topics = mk_topics([("x", 6, 3)])
+    live = {0, 1, 2, 3, 4}
+    prev = assign_partitions(topics, sorted(live))
+    for _ in range(40):
+        if len(live) > 3 and rng.random() < 0.5:
+            live.discard(rng.choice(sorted(live)))
+        else:
+            live.add(rng.randrange(8))
+        new = assign_partitions(topics, sorted(live), previous=prev)
+        for t_new, t_prev in zip(new, prev):
+            for a_new, a_prev in zip(t_new.assignments, t_prev.assignments):
+                for i, b in enumerate(a_prev.replicas):
+                    if b in live:
+                        assert a_new.replicas[i] == b
+        prev = new
+
+
 def test_assign_infeasible_rf_raises():
     topics = mk_topics([("t", 1, 3)])
     with pytest.raises(ValueError):

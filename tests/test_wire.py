@@ -51,6 +51,28 @@ def test_codec_rejects_trailing_and_bad_tags():
         encode({1: "non-string key"})
 
 
+def test_codec_rejects_hostile_lengths():
+    """Malformed/hostile frames with negative or oversized length
+    prefixes must fail as clean decode errors, not empty slices or
+    backwards position moves."""
+    from ripplemq_tpu.wire.codec import _write_varint
+    import io
+
+    def varint(n):
+        out = io.BytesIO()
+        _write_varint(out, n)
+        return out.getvalue()
+
+    for tag in (b"s", b"b", b"l", b"m"):
+        with pytest.raises(ValueError):
+            decode(tag + varint(-1))          # negative length/count
+        with pytest.raises(ValueError):
+            decode(tag + varint(1 << 40))     # exceeds remaining buffer
+    # negative dict-key length inside an otherwise valid dict
+    with pytest.raises(ValueError):
+        decode(b"m" + varint(1) + varint(-3) + b"n")
+
+
 def test_inproc_basic_and_handler_error():
     net = InProcNetwork()
     net.register("b1", lambda req: {"ok": True, "echo": req["x"]})
