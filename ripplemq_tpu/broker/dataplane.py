@@ -531,6 +531,12 @@ def recover_image(cfg: EngineConfig, store_dir: str,
     (SURVEY.md §5 checkpoint) — here it also re-derives the cached
     last_term from the tail row's embedded header.
     """
+    # Heal erasure-protected sealed segments first: a missing/corrupt
+    # sealed segment is rebuilt from any 3 of its 5 RS shards (the torn-
+    # tail contract below only covers the ACTIVE segment's tail).
+    from ripplemq_tpu.storage.erasure import repair_store
+
+    repair_store(store_dir)
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
     log_data = np.zeros((P, S, SB), np.uint8)
     log_end = np.zeros((P,), np.int32)

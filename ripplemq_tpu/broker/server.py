@@ -98,7 +98,7 @@ class BrokerServer:
 
                     seg_dir = os.path.join(data_dir, "segments")
                     image = recover_image(config.engine, seg_dir)
-                    store = SegmentStore(seg_dir)
+                    store = SegmentStore(seg_dir, erasure=True)
                 self.dataplane = DataPlane(
                     config.engine, mode=engine_mode, store=store
                 )

@@ -1,0 +1,247 @@
+"""Erasure-coded protection for sealed log segments (RS(3,2) over GF(2⁸)).
+
+The reference's only durability story is JRaft's full replication — every
+broker stores every byte of every partition it replicates (reference:
+mq-broker/src/main/java/metadata/raft/PartitionRaftServer.java:88-90
+storage URIs; SURVEY.md §2.4). Here, sealed (rotated, immutable) segment
+files additionally get k+m = 5 Reed–Solomon shards at 5/3× overhead; any
+k = 3 surviving shards rebuild the segment byte-for-byte, so a corrupt or
+lost sealed segment no longer costs the data (the torn-tail contract only
+protects the ACTIVE segment's tail). Encoding runs the Pallas GF(2⁸)
+matmul kernel on TPU (ripplemq_tpu.ops.rs) and the XLA fallback
+elsewhere.
+
+Layout: shards of `segment-XXXXXXXX.log` live in `<store>/rs/` as
+`segment-XXXXXXXX.log.shard{0..4}`. Shard i < k is data quarter i; shard
+k+i is parity i. Each shard file carries its own CRC plus the CRC of the
+whole original segment, so repair can tell a stale shard set from a
+usable one.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ripplemq_tpu.ops.rs import rs_encode, rs_reconstruct
+
+K = 3
+M = 2
+
+_MAGIC = 0x52535348  # "RSSH"
+_VERSION = 1
+# magic, version, shard index, k, m, original segment length, crc of the
+# original segment bytes, crc of this shard's payload
+_HEADER = struct.Struct("<IBBBBQII")
+
+
+class ShardError(Exception):
+    pass
+
+
+def _rs_dir(store_dir: str) -> str:
+    return os.path.join(store_dir, "rs")
+
+
+def shard_paths(store_dir: str, seg_name: str) -> list[str]:
+    return [
+        os.path.join(_rs_dir(store_dir), f"{seg_name}.shard{i}")
+        for i in range(K + M)
+    ]
+
+
+def _shard_length(orig_len: int) -> int:
+    return -(-orig_len // K)  # ceil; last data shard is zero-padded
+
+
+def encode_segment(store_dir: str, seg_name: str, **kw) -> list[str]:
+    """Write the K+M shard files for one sealed segment. Atomic per shard
+    (tmp + rename); returns the shard paths."""
+    seg_path = os.path.join(store_dir, seg_name)
+    with open(seg_path, "rb") as f:
+        raw = f.read()
+    data_crc = zlib.crc32(raw) & 0xFFFFFFFF
+    n = _shard_length(len(raw))
+    padded = np.zeros(K * n, np.uint8)
+    padded[: len(raw)] = np.frombuffer(raw, np.uint8)
+    data = padded.reshape(K, n)
+    parity = np.asarray(rs_encode(data, k=K, m=M, **kw))
+    shards = np.concatenate([data, parity], axis=0)
+    os.makedirs(_rs_dir(store_dir), exist_ok=True)
+    paths = shard_paths(store_dir, seg_name)
+    for i, path in enumerate(paths):
+        payload = shards[i].tobytes()
+        header = _HEADER.pack(
+            _MAGIC, _VERSION, i, K, M, len(raw), data_crc,
+            zlib.crc32(payload) & 0xFFFFFFFF,
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(header + payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    return paths
+
+
+def _read_shard(path: str) -> Optional[tuple[int, int, int, np.ndarray]]:
+    """→ (index, orig_len, data_crc, payload) or None if missing/corrupt."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    if len(blob) < _HEADER.size:
+        return None
+    magic, version, idx, k, m, orig_len, data_crc, shard_crc = _HEADER.unpack(
+        blob[: _HEADER.size]
+    )
+    if magic != _MAGIC or version != _VERSION or (k, m) != (K, M):
+        return None
+    payload = blob[_HEADER.size :]
+    if len(payload) != _shard_length(orig_len):
+        return None
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != shard_crc:
+        return None
+    return idx, orig_len, data_crc, np.frombuffer(payload, np.uint8)
+
+
+def reconstruct_segment(store_dir: str, seg_name: str, **kw) -> bytes:
+    """Rebuild one segment's bytes from any K valid shards. Raises
+    ShardError if fewer than K shards survive or the rebuilt bytes fail
+    the recorded segment CRC."""
+    present: dict[int, np.ndarray] = {}
+    meta: Optional[tuple[int, int]] = None
+    for path in shard_paths(store_dir, seg_name):
+        got = _read_shard(path)
+        if got is None:
+            continue
+        idx, orig_len, data_crc, payload = got
+        if meta is None:
+            meta = (orig_len, data_crc)
+        elif meta != (orig_len, data_crc):
+            raise ShardError(f"mixed shard generations for {seg_name}")
+        present[idx] = payload
+    if meta is None or len(present) < K:
+        raise ShardError(
+            f"{seg_name}: only {len(present)} valid shards, need {K}"
+        )
+    orig_len, data_crc = meta
+    if all(i in present for i in range(K)):
+        data = np.stack([present[i] for i in range(K)])
+    else:
+        data = np.asarray(rs_reconstruct(present, k=K, m=M, **kw))
+    raw = data.reshape(-1).tobytes()[:orig_len]
+    if (zlib.crc32(raw) & 0xFFFFFFFF) != data_crc:
+        raise ShardError(f"{seg_name}: reconstructed bytes fail segment CRC")
+    return raw
+
+
+def _segment_names(store_dir: str) -> list[str]:
+    if not os.path.isdir(store_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(store_dir)
+        if f.startswith("segment-") and f.endswith(".log")
+    )
+
+
+def _shard_counts(store_dir: str) -> dict[str, int]:
+    rs_dir = _rs_dir(store_dir)
+    if not os.path.isdir(rs_dir):
+        return {}
+    counts: dict[str, int] = {}
+    for f in os.listdir(rs_dir):
+        stem, _, suffix = f.rpartition(".shard")
+        if stem and suffix.isdigit():
+            counts[stem] = counts.get(stem, 0) + 1
+    return counts
+
+
+def _protected_names(store_dir: str) -> set[str]:
+    """Segment names with at least one shard file present (repair decides
+    usability from shard CONTENTS — presence of any shard is enough to
+    consider the set, since up to M shards may themselves be lost)."""
+    return set(_shard_counts(store_dir))
+
+
+def protect_store(store_dir: str, limit: Optional[int] = None,
+                  **kw) -> list[str]:
+    """Encode shards for sealed segments (every segment but the highest-
+    numbered, which is still being appended) that lack a COMPLETE shard
+    set — a crash mid-encode leaves a partial set, which must not count
+    as protected (it may tolerate fewer than M losses, or none). Empty
+    segments (a restart artifact: both store backends open a fresh index
+    on boot) carry no data and are skipped. `limit` bounds work per call
+    so callers can amortize. Returns the segment names encoded."""
+    names = _segment_names(store_dir)[:-1]
+    counts = _shard_counts(store_dir)
+    done = []
+    for name in names:
+        if counts.get(name, 0) >= K + M:
+            continue
+        if os.path.getsize(os.path.join(store_dir, name)) == 0:
+            continue
+        encode_segment(store_dir, name, **kw)
+        done.append(name)
+        if limit is not None and len(done) >= limit:
+            break
+    return done
+
+
+def repair_store(store_dir: str, **kw) -> list[str]:
+    """Rebuild sealed segment files that are missing or fail their shard-
+    recorded CRC. Called before replay (recover_image). Best-effort by
+    design: segments without shard sets — and ones whose shard sets are
+    too damaged to reconstruct (> M losses) — are left to the scanner's
+    own corruption handling, so a half-dead shard set degrades exactly
+    like a dead one instead of blocking broker boot. Returns the segment
+    names repaired."""
+    repaired = []
+    for name in sorted(_protected_names(store_dir)):
+        seg_path = os.path.join(store_dir, name)
+        meta = None
+        valid_shards = 0
+        for path in shard_paths(store_dir, name):
+            got = _read_shard(path)
+            if got is not None:
+                meta = got
+                valid_shards += 1
+        if meta is None:
+            continue  # shard set itself is dead; nothing to do
+        _, orig_len, data_crc, _ = meta
+        try:
+            with open(seg_path, "rb") as f:
+                raw = f.read()
+            healthy = (
+                len(raw) == orig_len
+                and (zlib.crc32(raw) & 0xFFFFFFFF) == data_crc
+            )
+        except OSError:
+            healthy = False
+        if not healthy:
+            try:
+                raw = reconstruct_segment(store_dir, name, **kw)
+            except ShardError:
+                continue  # > M losses: fall through to the scanner
+            tmp = seg_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, seg_path)
+            repaired.append(name)
+        if valid_shards < K + M:
+            # Restore full m-loss tolerance: re-derive the lost/corrupt
+            # shards from the (now healthy) segment bytes. Best-effort —
+            # shards are derived data; failing to rewrite them must not
+            # block recovery.
+            try:
+                encode_segment(store_dir, name, **kw)
+            except OSError:
+                pass
+    return repaired

@@ -92,12 +92,25 @@ class CorruptStoreError(Exception):
 
 
 class SegmentStore:
-    """Writer. `use_native=None` auto-selects the C++ library."""
+    """Writer. `use_native=None` auto-selects the C++ library.
+
+    `erasure=True` additionally RS(3,2)-encodes sealed segments from a
+    background thread kicked by flush(): any 3 of the 5 shards rebuild a
+    lost/corrupt sealed segment on recovery (see storage/erasure.py;
+    repair runs in recover_image before replay). The encode runs OFF the
+    flush path — flush is the replication step thread's durability
+    barrier and must not stall for a whole segment's GF matmul — and an
+    unencoded sealed segment is simply picked up by a later kick."""
 
     def __init__(self, directory: str, segment_bytes: int = 64 << 20,
-                 use_native: Optional[bool] = None) -> None:
+                 use_native: Optional[bool] = None,
+                 erasure: bool = False) -> None:
         self.directory = directory
         self.segment_bytes = segment_bytes
+        self.erasure = erasure
+        self._erasure_thread: Optional[threading.Thread] = None
+        self._erasure_check_t = 0.0
+        self.erasure_errors: list[str] = []
         os.makedirs(directory, exist_ok=True)
         lib = _load_native() if use_native in (None, True) else None
         if use_native is True and lib is None:
@@ -166,6 +179,42 @@ class SegmentStore:
             else:
                 self._file.flush()
                 os.fsync(self._file.fileno())
+        if self.erasure:
+            self._kick_erasure()
+
+    def _kick_erasure(self) -> None:
+        """Start (or skip, if one is running) the background shard
+        encoder; rate-limited so rotation-free flushes don't pay even a
+        listdir."""
+        import time
+
+        now = time.monotonic()
+        if now - self._erasure_check_t < 1.0:
+            return
+        self._erasure_check_t = now
+        t = self._erasure_thread
+        if t is not None and t.is_alive():
+            return
+        t = threading.Thread(
+            target=self._erasure_worker, daemon=True, name="segstore-erasure"
+        )
+        self._erasure_thread = t
+        t.start()
+
+    def _erasure_worker(self) -> None:
+        from ripplemq_tpu.storage.erasure import protect_store
+
+        try:
+            protect_store(self.directory)
+        except Exception as e:  # derived data: never take the store down
+            self.erasure_errors.append(f"{type(e).__name__}: {e}")
+            del self.erasure_errors[:-20]
+
+    def wait_erasure(self, timeout: Optional[float] = None) -> None:
+        """Join an in-flight background encode (tests / orderly shutdown)."""
+        t = self._erasure_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
 
     def close(self) -> None:
         with self._lock:
@@ -177,6 +226,11 @@ class SegmentStore:
                 os.fsync(self._file.fileno())
                 self._file.close()
                 self._file = None
+        if self.erasure:
+            # Orderly shutdown: finish protection synchronously (the
+            # background worker may be mid-encode or rate-limited out).
+            self.wait_erasure(timeout=30)
+            self._erasure_worker()
 
 
 def scan_store(
