@@ -1,0 +1,95 @@
+"""Broker process entry: `python -m ripplemq_tpu.broker --id N --config F`.
+
+The reference boots from `ApplicationMain.main` (reference:
+mq-broker/src/main/java/app/ApplicationMain.java:12-54 — load YAML, build
+BrokerServer, start, register a shutdown hook) and is launched as
+`-id N` per container (mq-broker/docker-compose.yml:8). Same shape here,
+with two documented deviations: the broker id is a proper `--id` flag
+(the reference checks `args.length < 1` but reads `args[1]` —
+ApplicationMain.java:15-20), and the process exits non-zero on a bad
+config instead of stack-tracing.
+
+A 5-broker cluster equivalent to the reference's docker-compose is:
+
+    for i in 0 1 2 3 4; do
+        python -m ripplemq_tpu.broker --id $i --config examples/cluster.yaml \
+            --data-dir /var/lib/ripplemq &
+    done
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ripplemq_tpu.broker",
+        description="Start one RippleMQ-TPU broker.",
+    )
+    ap.add_argument("--id", type=int, required=True, dest="broker_id",
+                    help="this broker's id (must appear in the config roster)")
+    ap.add_argument("--config", required=True,
+                    help="cluster config YAML (roster + topics + engine)")
+    ap.add_argument("--data-dir", default=None,
+                    help="durable storage root; segments + metadata live "
+                         "under <data-dir>/broker-<id>/ (omit for in-memory)")
+    ap.add_argument("--engine-mode", default="local",
+                    choices=["local", "spmd"],
+                    help="device binding for the controller's engine: "
+                         "'local' vmaps replicas on one chip, 'spmd' shards "
+                         "a (replica x part) device mesh")
+    args = ap.parse_args(argv)
+
+    from ripplemq_tpu.broker.server import BrokerServer
+    from ripplemq_tpu.metadata.cluster_config import load_cluster_config
+
+    try:
+        config = load_cluster_config(args.config)
+        config.broker(args.broker_id)  # fail fast on an id not in the roster
+    except (OSError, ValueError, KeyError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    data_dir = None
+    if args.data_dir is not None:
+        data_dir = os.path.join(args.data_dir, f"broker-{args.broker_id}")
+        os.makedirs(data_dir, exist_ok=True)
+
+    server = BrokerServer(
+        args.broker_id, config,
+        net=None,  # real TCP sockets
+        engine_mode=args.engine_mode,
+        data_dir=data_dir,
+    )
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):  # the reference's shutdown hook
+        stop.set()
+
+    signal.signal(signal.SIGINT, _on_signal)
+    signal.signal(signal.SIGTERM, _on_signal)
+
+    server.start()
+    role = "controller" if server.is_controller else "frontend"
+    print(
+        f"ripplemq-tpu broker {args.broker_id} ({role}) serving on "
+        f"{server.addr}",
+        flush=True,
+    )
+    try:
+        while not stop.wait(timeout=1.0):
+            pass
+    finally:
+        server.stop()
+        print(f"broker {args.broker_id} stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

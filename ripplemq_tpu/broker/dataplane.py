@@ -82,6 +82,8 @@ class DataPlane:
         max_retry_rounds: int = 8,
         store: Optional[SegmentStore] = None,
         flush_interval_s: float = 0.05,
+        pipeline_depth: int = 8,
+        coalesce_s: float = 0.002,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -117,6 +119,37 @@ class DataPlane:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="dataplane-step"
         )
+        # Two-stage round pipeline: the STEP thread only drains queues and
+        # dispatches device rounds; the RESOLVER thread blocks on each
+        # round's (base, committed) host fetch, persists it, and settles
+        # its futures — in dispatch order. The device executes rounds in
+        # dispatch order, so this changes nothing semantically; it keeps
+        # the dispatch path free of host↔device sync latency (which
+        # dominates when the chip sits behind a network tunnel: ~100 ms
+        # RTT vs ~3 ms of compute — new arrivals must not wait behind a
+        # blocking fetch). The bounded queue backpressures dispatch at
+        # `pipeline_depth` outstanding rounds. Per-slot serialization
+        # (busy sets) keeps at most ONE in-flight round per partition, so
+        # a failed round's retries can never be reordered behind later
+        # submits for the same partition.
+        import queue as _queue
+
+        self.pipeline_depth = max(1, pipeline_depth)
+        # Coalescing window: when few submissions are pending, wait this
+        # long before dispatching so a whole burst of concurrent
+        # producers lands in ONE round — every round costs a full
+        # host↔device sync to resolve, which dwarfs the window (~100 ms
+        # behind a tunnel, ~1 ms attached). 0 disables.
+        self.coalesce_s = coalesce_s
+        self._inflight: "_queue.Queue[tuple[StepInput, dict, object]]" = (
+            _queue.Queue(maxsize=self.pipeline_depth)
+        )
+        self._resolver = threading.Thread(
+            target=self._resolve_loop, daemon=True, name="dataplane-resolve"
+        )
+        # Guarded by self._lock (read by _drain, cleared by the resolver).
+        self._busy_a: set[int] = set()   # partition slots with appends in flight
+        self._busy_o: set[int] = set()   # ... with offset commits in flight
         # Metrics (host-side counters; see utils.metrics for the registry).
         self.rounds = 0
         self.committed_entries = 0
@@ -124,11 +157,13 @@ class DataPlane:
 
     def start(self) -> None:
         self._thread.start()
+        self._resolver.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5)
+        self._resolver.join(timeout=10)  # lands every dispatched round
         if self.store is not None:
             self.store.flush()
 
@@ -349,6 +384,8 @@ class DataPlane:
             round_offsets: dict[int, list[_PendingOffsets]] = {}
 
             for slot, queue in list(self._appends.items()):
+                if slot in self._busy_a:
+                    continue  # one in-flight round per slot (ordering)
                 taken: list[tuple[_Pending, int, int]] = []
                 fill = 0
                 batch: list[bytes] = []
@@ -366,6 +403,8 @@ class DataPlane:
                     self._appends.pop(slot, None)
 
             for slot, queue in list(self._offsets.items()):
+                if slot in self._busy_o:
+                    continue
                 taken_off: list[_PendingOffsets] = []
                 fill = 0
                 while queue and fill + len(queue[0].payloads) <= U:
@@ -398,30 +437,79 @@ class DataPlane:
                      "alive": alive, "quorum": quorum}
 
     def _run(self) -> None:
+        """Step thread: drain → dispatch → hand off to the resolver."""
         while not self._stop.is_set():
             ctx = None
             try:
+                if self.coalesce_s > 0:
+                    with self._lock:
+                        npend = sum(len(q) for q in self._appends.values())
+                    if 0 < npend < self.cfg.max_batch:
+                        time.sleep(self.coalesce_s)  # gather the burst
                 work = self._drain()
                 if work is None:
                     self._work.clear()
-                    self._work.wait(timeout=0.5)
+                    # Short timeout: pendings for busy slots become
+                    # drainable when the resolver clears the slot, which
+                    # does not set the work event.
+                    self._work.wait(timeout=0.02)
                     continue
                 inp, ctx = work
                 with self._device_lock:
                     self._state, out = self.fns.step(
                         self._state, inp, ctx["alive"], ctx["quorum"]
                     )
-                    base = np.asarray(out.base)
-                    committed = np.asarray(out.committed)
                 self.rounds += 1
-                self._persist_round(inp, ctx, base, committed)
-                self._settle(ctx, base, committed)
+                for leaf in (out.base, out.committed):
+                    start_async = getattr(leaf, "copy_to_host_async", None)
+                    if start_async is not None:
+                        start_async()  # overlap D2H with later rounds
+                with self._lock:
+                    self._busy_a |= ctx["appends"].keys()
+                    self._busy_o |= ctx["offsets"].keys()
+                # Blocks at pipeline_depth outstanding rounds (backpressure).
+                self._inflight.put((inp, ctx, out))
+                ctx = None  # now owned by the resolver
             except Exception as e:  # the step thread must never die: fail
                 # this round's futures and keep serving (one bad round must
                 # not wedge the whole data plane).
                 self.step_errors += 1
                 if ctx is not None:
+                    with self._lock:
+                        self._busy_a -= ctx["appends"].keys()
+                        self._busy_o -= ctx["offsets"].keys()
                     self._fail_round(ctx, e)
+
+    def _resolve_loop(self) -> None:
+        """Resolver thread: land rounds in dispatch order."""
+        import queue as _queue
+
+        while True:
+            try:
+                item = self._inflight.get(timeout=0.05)
+            except _queue.Empty:
+                if self._stop.is_set() and not self._thread.is_alive():
+                    return
+                continue
+            self._resolve_one(*item)
+
+    def _resolve_one(self, inp: StepInput, ctx: dict, out) -> None:
+        """Fetch one round's outputs (blocking) and settle its futures.
+        Failures fail that round's futures only. The slot stays busy
+        until AFTER _settle so retry requeues land at the queue front
+        before drain can take later submits for the same slot."""
+        try:
+            base = np.asarray(out.base)
+            committed = np.asarray(out.committed)
+            self._persist_round(inp, ctx, base, committed)
+            self._settle(ctx, base, committed)
+        except Exception as e:
+            self.step_errors += 1
+            self._fail_round(ctx, e)
+        finally:
+            with self._lock:
+                self._busy_a -= ctx["appends"].keys()
+                self._busy_o -= ctx["offsets"].keys()
 
     def _persist_round(self, inp: StepInput, ctx, base, committed) -> None:
         """Frame this round's committed writes into the segment store."""
