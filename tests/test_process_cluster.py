@@ -116,7 +116,7 @@ def _wait_for_leaders(bootstrap, deadline_s=90.0):
             time.sleep(0.3)
         raise AssertionError("cluster never elected leaders for all partitions")
     finally:
-        meta.stop()
+        meta.close()
         transport.close()
 
 
