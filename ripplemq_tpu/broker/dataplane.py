@@ -84,6 +84,7 @@ class DataPlane:
         flush_interval_s: float = 0.05,
         pipeline_depth: int = 8,
         coalesce_s: float = 0.002,
+        replicate_fn=None,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -94,6 +95,15 @@ class DataPlane:
         self.store = store
         self.flush_interval_s = flush_interval_s
         self._last_flush = 0.0
+        # Controller-failover hook: called with each round's committed
+        # records AFTER local persistence and BEFORE settling futures —
+        # the resolver blocks until the standby set acked, so a settled
+        # append provably exists on every replication standby (zero
+        # committed-entry loss across controller death; see
+        # broker/replication.py). Raising fails the round's futures
+        # (FencedError ⊂ NotCommittedError → producers retry at the new
+        # controller).
+        self.replicate_fn = replicate_fn
         if mode == "local":
             self.fns = make_local_fns(cfg)
         elif mode == "spmd":
@@ -166,6 +176,20 @@ class DataPlane:
         self._resolver.join(timeout=10)  # lands every dispatched round
         if self.store is not None:
             self.store.flush()
+        # Nothing will ever drain the queues again: fail leftovers instead
+        # of letting their futures hang until caller timeouts (matters on
+        # controller fencing, where the deposed data plane stops while
+        # frontends still hold futures).
+        with self._lock:
+            leftovers = [p for q in self._appends.values() for p in q]
+            leftovers += [p for q in self._offsets.values() for p in q]
+            self._appends.clear()
+            self._offsets.clear()
+        for p in leftovers:
+            if not p.future.done():
+                p.future.set_exception(
+                    NotCommittedError("data plane stopped")
+                )
 
     # ------------------------------------------------------------- control
 
@@ -443,7 +467,14 @@ class DataPlane:
             try:
                 if self.coalesce_s > 0:
                     with self._lock:
-                        npend = sum(len(q) for q in self._appends.values())
+                        # Only pendings on non-busy slots count: queues
+                        # behind an in-flight round cannot be drained this
+                        # iteration, so sleeping for them delays the
+                        # drainable work (and offset commits) for nothing.
+                        npend = sum(
+                            len(q) for slot, q in self._appends.items()
+                            if slot not in self._busy_a
+                        )
                     if 0 < npend < self.cfg.max_batch:
                         time.sleep(self.coalesce_s)  # gather the burst
                 work = self._drain()
@@ -501,7 +532,10 @@ class DataPlane:
         try:
             base = np.asarray(out.base)
             committed = np.asarray(out.committed)
-            self._persist_round(inp, ctx, base, committed)
+            records = self._round_records(inp, ctx, base, committed)
+            self._persist_round(records)
+            if self.replicate_fn is not None and records:
+                self.replicate_fn(records)
             self._settle(ctx, base, committed)
         except Exception as e:
             self.step_errors += 1
@@ -511,10 +545,10 @@ class DataPlane:
                 self._busy_a -= ctx["appends"].keys()
                 self._busy_o -= ctx["offsets"].keys()
 
-    def _persist_round(self, inp: StepInput, ctx, base, committed) -> None:
-        """Frame this round's committed writes into the segment store."""
-        if self.store is None:
-            return
+    def _round_records(self, inp: StepInput, ctx, base, committed
+                       ) -> list[tuple[int, int, int, bytes]]:
+        """This round's committed writes as store/replication records."""
+        records: list[tuple[int, int, int, bytes]] = []
         entries = np.asarray(inp.entries)
         counts = np.asarray(inp.counts)
         for slot in ctx["appends"]:
@@ -522,13 +556,21 @@ class DataPlane:
                 continue
             adv = int(-(-int(counts[slot]) // ALIGN) * ALIGN)
             payload = entries[slot, :adv].tobytes()
-            self.store.append(REC_APPEND, int(slot), int(base[slot]), payload)
+            records.append((REC_APPEND, int(slot), int(base[slot]), payload))
         for slot, taken_off in ctx["offsets"].items():
             if not committed[slot]:
                 continue
             pairs = [p for pend in taken_off for p in pend.payloads]
             payload = b"".join(struct.pack("<II", s, o) for s, o in pairs)
-            self.store.append(REC_OFFSETS, int(slot), len(pairs), payload)
+            records.append((REC_OFFSETS, int(slot), len(pairs), payload))
+        return records
+
+    def _persist_round(self, records) -> None:
+        """Frame this round's committed records into the segment store."""
+        if self.store is None or not records:
+            return
+        for rec_type, slot, base, payload in records:
+            self.store.append(rec_type, slot, base, payload)
         now = time.monotonic()
         if now - self._last_flush >= self.flush_interval_s:
             self.store.flush()
@@ -610,21 +652,35 @@ class DataPlane:
 
 def recover_image(cfg: EngineConfig, store_dir: str,
                   use_native: Optional[bool] = None) -> Optional[ReplicaState]:
-    """Replay a segment store into a single-replica state image.
-
-    Returns None if the store holds no records. Only committed rounds were
-    ever persisted, so the rebuilt image is a valid post-commit state for
-    EVERY replica slot (install via DataPlane.install). The replay is the
-    recovery path the reference inherits from JRaft's log replay
-    (SURVEY.md §5 checkpoint) — here it also re-derives the cached
-    last_term from the tail row's embedded header.
-    """
-    # Heal erasure-protected sealed segments first: a missing/corrupt
-    # sealed segment is rebuilt from any 3 of its 5 RS shards (the torn-
-    # tail contract below only covers the ACTIVE segment's tail).
+    """Replay a segment store directory into a single-replica state image,
+    healing erasure-protected sealed segments first: a missing/corrupt
+    sealed segment is rebuilt from any 3 of its 5 RS shards (the torn-
+    tail contract of replay_records only covers the ACTIVE segment's
+    tail)."""
     from ripplemq_tpu.storage.erasure import repair_store
 
     repair_store(store_dir)
+    return replay_records(cfg, scan_store(store_dir, use_native))
+
+
+def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
+    """Replay committed-round records into a single-replica state image.
+
+    Returns None if there are no records. Only committed rounds are ever
+    persisted/replicated, so the rebuilt image is a valid post-commit
+    state for EVERY replica slot (install via DataPlane.install). The
+    replay is the recovery path the reference inherits from JRaft's log
+    replay (SURVEY.md §5 checkpoint) — here it also re-derives the cached
+    last_term from the tail row's embedded header.
+
+    Later records win per slot: a record's base may regress below an
+    earlier record's end (a controller-failover standby can hold an
+    UNSETTLED round the promoted controller never had — the new
+    generation's rounds re-cover those rows) and may leave a zero-row gap
+    (the standby missed an unsettled round the deposed controller
+    persisted locally). Both only ever affect rows whose producers were
+    NEVER acked; zero rows read back as alignment padding.
+    """
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
     log_data = np.zeros((P, S, SB), np.uint8)
     log_end = np.zeros((P,), np.int32)
@@ -632,7 +688,7 @@ def recover_image(cfg: EngineConfig, store_dir: str,
     commit = np.zeros((P,), np.int32)
     offsets = np.zeros((P, C), np.int32)
     found = False
-    for rec_type, slot, base, payload in scan_store(store_dir, use_native):
+    for rec_type, slot, base, payload in records:
         if not 0 <= slot < P:
             raise ValueError(
                 f"record for partition {slot} outside engine shape P={P} "

@@ -49,6 +49,11 @@ from ripplemq_tpu.metadata.models import (
 OP_SET_TOPICS = "set_topics"
 OP_SET_LEADER = "set_leader"
 OP_REGISTER_CONSUMER = "register_consumer"
+# Controller-failover ops (broker/replication.py): which broker drives
+# the device program (fenced by a monotone epoch) and which brokers hold
+# a full copy of its committed-round stream (the standby set).
+OP_SET_CONTROLLER = "set_controller"
+OP_SET_STANDBYS = "set_standbys"
 
 
 def build_slot_map(config: ClusterConfig) -> dict[GroupKey, int]:
@@ -78,6 +83,12 @@ class PartitionManager:
         self.live: list[int] = list(config.broker_ids())
         self.consumers: dict[str, int] = {}
         self._applied_index = 0
+        # Controller-failover state: the active controller, its fencing
+        # epoch, and the standby set holding its committed-round stream.
+        # Epoch 0 is the config-designated bootstrap controller.
+        self.controller_broker: int = config.controller
+        self.controller_epoch: int = 0
+        self.standbys: tuple[int, ...] = ()
 
     # ------------------------------------------------- state machine hooks
 
@@ -98,6 +109,15 @@ class PartitionManager:
                 )
             elif op == OP_REGISTER_CONSUMER:
                 self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
+            elif op == OP_SET_CONTROLLER:
+                self._apply_set_controller(
+                    int(cmd["controller"]), int(cmd["epoch"]),
+                    [int(b) for b in cmd["standbys"]],
+                )
+            elif op == OP_SET_STANDBYS:
+                self._apply_set_standbys(
+                    int(cmd["epoch"]), [int(b) for b in cmd["standbys"]]
+                )
             # Unknown ops are ignored (forward compatibility).
 
     def snapshot(self) -> dict:
@@ -107,15 +127,43 @@ class PartitionManager:
                 "topics": topics_to_wire(self.topics),
                 "live": list(self.live),
                 "consumers": dict(self.consumers),
+                "controller": self.controller_broker,
+                "controller_epoch": self.controller_epoch,
+                "standbys": list(self.standbys),
             }
 
     def restore(self, state: dict) -> None:
         """hostraft restore_fn — install a metadata snapshot."""
         with self.lock:
             self.consumers = {str(k): int(v) for k, v in state["consumers"].items()}
+            # Controller fields default to bootstrap values for snapshots
+            # written before the failover machinery existed.
+            self.controller_broker = int(
+                state.get("controller", self.config.controller)
+            )
+            self.controller_epoch = int(state.get("controller_epoch", 0))
+            self.standbys = tuple(int(b) for b in state.get("standbys", ()))
             self._apply_set_topics(
                 topics_from_wire(state["topics"]), [int(b) for b in state["live"]]
             )
+
+    def _apply_set_controller(
+        self, controller: int, epoch: int, standbys: list[int]
+    ) -> None:
+        """Monotone-epoch controller handover (stale proposals ignored)."""
+        if epoch <= self.controller_epoch:
+            return
+        self.controller_broker = controller
+        self.controller_epoch = epoch
+        self.standbys = tuple(b for b in standbys if b != controller)
+
+    def _apply_set_standbys(self, epoch: int, standbys: list[int]) -> None:
+        """Standby-set rewrite, valid only within the current epoch."""
+        if epoch != self.controller_epoch:
+            return
+        self.standbys = tuple(
+            b for b in standbys if b != self.controller_broker
+        )
 
     def _apply_register_consumer(self, name: str, slot: int) -> None:
         """Idempotent consumer registration. The proposed slot was chosen
@@ -264,7 +312,36 @@ class PartitionManager:
                             pairs.setdefault((src, r), []).append(slot)
             return pairs
 
+    # -------------------------------------------- dataplane attach/detach
+
+    def attach_dataplane(self, dataplane: DataPlane) -> None:
+        """Bind a (newly booted) device program and push the current
+        replicated control state into its tables — the takeover half of
+        controller failover (broker/server.py _takeover_duty)."""
+        with self.lock:
+            self.dataplane = dataplane
+            if self.topics:
+                self._push_control_tables()
+
+    def detach_dataplane(self) -> Optional[DataPlane]:
+        """Unbind the device program (controller fencing); returns it."""
+        with self.lock:
+            dp, self.dataplane = self.dataplane, None
+            return dp
+
     # ------------------------------------------------------------- queries
+
+    def current_controller(self) -> int:
+        with self.lock:
+            return self.controller_broker
+
+    def current_epoch(self) -> int:
+        with self.lock:
+            return self.controller_epoch
+
+    def current_standbys(self) -> tuple[int, ...]:
+        with self.lock:
+            return self.standbys
 
     def get_topics(self) -> list[Topic]:
         with self.lock:
@@ -332,6 +409,55 @@ class PartitionManager:
                 "topics": topics_to_wire(new_topics),
                 "live": sorted(alive_brokers),
             }
+
+    def plan_controller(self, alive_brokers: list[int]) -> Optional[dict]:
+        """Called on the metadata leader: controller-failover planning.
+
+        Dead controller → promote the lowest-id live STANDBY under a
+        bumped epoch (only set members hold the full committed-round
+        stream — promoting anyone else would lose acked data, so with no
+        live standby the plane stays down until the controller returns,
+        exactly the pre-failover behavior). Live controller → prune dead
+        brokers from the standby set (the controller duty re-adds fresh
+        ones via catch-up). The reference's analogue is JRaft re-electing
+        any partition's leader among surviving replicas
+        (PartitionRaftServer.java:83-93)."""
+        with self.lock:
+            alive = set(alive_brokers)
+            if self.controller_broker in alive:
+                if any(s not in alive for s in self.standbys):
+                    return {
+                        "op": OP_SET_STANDBYS,
+                        "epoch": self.controller_epoch,
+                        "standbys": [s for s in self.standbys if s in alive],
+                    }
+                return None
+            cands = [s for s in self.standbys if s in alive]
+            if not cands:
+                return None
+            new = min(cands)
+            return {
+                "op": OP_SET_CONTROLLER,
+                "controller": new,
+                "epoch": self.controller_epoch + 1,
+                "standbys": [s for s in cands if s != new],
+            }
+
+    def plan_standby_add(self, target_count: int) -> Optional[int]:
+        """Called on the controller: pick one live broker to catch up and
+        admit to the standby set (None if the set is at target). The
+        lowest id wins so repeated calls are stable."""
+        with self.lock:
+            if self.controller_broker != self.broker_id:
+                return None
+            live = set(self.live)
+            others = live - {self.broker_id}
+            want = min(target_count, len(others))
+            members_live = [s for s in self.standbys if s in live]
+            if len(members_live) >= want:
+                return None
+            cands = sorted(others - set(self.standbys))
+            return cands[0] if cands else None
 
     # --------------------------------------------- controller duty logic
 

@@ -1,0 +1,332 @@
+"""Committed-round replication: controller → standby set, with fencing.
+
+The reference tolerates the loss of ANY broker because every broker runs
+its own JRaft groups with their own durable logs and elections move
+leadership wherever replicas survive (reference:
+mq-broker/src/main/java/metadata/raft/PartitionRaftServer.java:83-93).
+In the TPU design the whole partition data plane is ONE device program
+driven by one controller broker, so that fault-tolerance property must be
+rebuilt around the program: this module chain-replicates the controller's
+committed-round record stream — the exact (rec_type, slot, base, payload)
+frames the segment store persists (storage/segment.py REC_APPEND /
+REC_OFFSETS) — to a *standby set* recorded in the replicated metadata
+(PartitionManager: controller broker + controller epoch + standby list).
+
+Protocol invariants:
+
+- **Settle-after-ack.** The DataPlane resolver calls `replicate()` after
+  local persistence and BEFORE settling producer futures; `replicate()`
+  blocks until every broker in the current standby set acked the round.
+  Hence every *settled* append exists on every standby — promoting any
+  set member loses no acked entry (zero committed-entry loss).
+- **Epoch fencing.** Every `repl.rounds` RPC carries the controller
+  epoch. A standby whose replicated metadata knows a newer epoch rejects
+  with `stale_epoch`; the deposed controller's rounds then fail with
+  FencedError (⊂ NotCommittedError), producers retry, and the metadata
+  routes them to the new controller. The sender also fences locally the
+  moment its own metadata shows another controller.
+- **Ordered per-standby stream.** Each standby has one sender thread
+  with a FIFO queue, so records arrive in commit order (duplicates are
+  harmless: replay is later-record-wins per slot, dataplane.replay_records).
+- **Catch-up join.** A broker enters the standby set only after
+  receiving the controller's full store prefix: the sender is switched
+  to *buffering* (live rounds hold in a side buffer), the store is
+  scanned into catch-up batches on the primary queue, then the buffer
+  flushes behind them. Any record the scan missed (including a torn
+  concurrent tail) was persisted after buffering began, so its live copy
+  is buffered — order and completeness both hold; only then is the
+  OP_SET_STANDBYS membership proposed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from ripplemq_tpu.broker.dataplane import NotCommittedError
+from ripplemq_tpu.wire.transport import RpcError, Transport
+
+
+class FencedError(NotCommittedError):
+    """This controller's epoch is stale: a newer controller exists."""
+
+
+class ReplicationError(NotCommittedError):
+    """A standby stream died under a round (sender stopped while its
+    target was still a set member): the round MUST NOT settle — acking
+    without the member's copy would break the zero-loss invariant."""
+
+
+_CATCHUP_BATCH_RECORDS = 256
+_CATCHUP_BATCH_BYTES = 1 << 20
+
+
+class _Sender(threading.Thread):
+    """Ordered record stream to one standby broker."""
+
+    def __init__(self, rep: "RoundReplicator", broker_id: int) -> None:
+        super().__init__(daemon=True, name=f"repl-sender-{broker_id}")
+        self.broker_id = broker_id
+        self._rep = rep
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: list[tuple[list, Future]] = []
+        self._buffer: Optional[list[tuple[list, Future]]] = None
+        self._stopped = False
+        self.unreachable = False  # consecutive send failures observed
+
+    # -- enqueue (any thread) --
+
+    def enqueue(self, records: list) -> Future:
+        """Live round: behind the catch-up stream while buffering."""
+        fut: Future = Future()
+        with self._cond:
+            if self._stopped:
+                fut.set_exception(ReplicationError("sender stopped"))
+                return fut
+            if self._buffer is not None:
+                self._buffer.append((records, fut))
+            else:
+                self._queue.append((records, fut))
+                self._cond.notify()
+        return fut
+
+    def enqueue_catchup(self, records: list) -> Future:
+        """Catch-up batch: primary queue, ahead of buffered live rounds."""
+        fut: Future = Future()
+        with self._cond:
+            if self._stopped:
+                fut.set_exception(ReplicationError("sender stopped"))
+                return fut
+            self._queue.append((records, fut))
+            self._cond.notify()
+        return fut
+
+    def begin_buffer(self) -> None:
+        with self._cond:
+            if self._buffer is None:
+                self._buffer = []
+
+    def end_buffer(self) -> None:
+        with self._cond:
+            if self._buffer is not None:
+                self._queue.extend(self._buffer)
+                self._buffer = None
+                self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            leftovers = self._queue + (self._buffer or [])
+            self._queue = []
+            self._buffer = None
+            self._cond.notify()
+        for _, fut in leftovers:
+            if not fut.done():
+                fut.set_exception(ReplicationError("sender stopped"))
+
+    # -- send loop --
+
+    def run(self) -> None:
+        backoff = 0.05
+        failures = 0
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(timeout=0.2)
+                if self._stopped:
+                    return
+                records, fut = self._queue.pop(0)
+            while True:
+                if self._stopped:
+                    if not fut.done():
+                        fut.set_exception(ReplicationError("sender stopped"))
+                    break
+                if not self._rep.active():
+                    fut.set_exception(
+                        FencedError("controller deposed (local metadata)")
+                    )
+                    break
+                try:
+                    resp = self._rep.client.call(
+                        self._rep.addr_of(self.broker_id),
+                        {
+                            "type": "repl.rounds",
+                            "epoch": self._rep.epoch_fn(),
+                            "records": [
+                                [t, s, b, p] for t, s, b, p in records
+                            ],
+                        },
+                        timeout=self._rep.rpc_timeout_s,
+                    )
+                except RpcError:
+                    failures += 1
+                    if failures >= 3:
+                        self.unreachable = True
+                    time.sleep(min(0.5, backoff * failures))
+                    continue
+                failures = 0
+                self.unreachable = False
+                if resp.get("ok"):
+                    fut.set_result(True)
+                    break
+                if resp.get("error") == "stale_epoch":
+                    fut.set_exception(FencedError("standby reports newer epoch"))
+                    break
+                # Transient standby-side refusal (e.g. it believes itself
+                # the active controller until its fence duty runs): retry.
+                failures += 1
+                time.sleep(min(0.5, backoff * failures))
+
+
+class RoundReplicator:
+    """Controller-side fan-out of the committed-round stream.
+
+    `members_fn` returns the CURRENT replicated standby set (acks
+    required); `epoch_fn` the current controller epoch; `active_fn`
+    whether this broker still is the controller (local fencing).
+    """
+
+    def __init__(
+        self,
+        client: Transport,
+        addr_of: Callable[[int], str],
+        epoch_fn: Callable[[], int],
+        members_fn: Callable[[], tuple],
+        active_fn: Callable[[], bool],
+        rpc_timeout_s: float = 3.0,
+        ack_timeout_s: float = 5.0,
+    ) -> None:
+        self.client = client
+        self.addr_of = addr_of
+        self.epoch_fn = epoch_fn
+        self.members_fn = members_fn
+        self.active = active_fn
+        self.rpc_timeout_s = rpc_timeout_s
+        self.ack_timeout_s = ack_timeout_s
+        self._lock = threading.Lock()
+        self._senders: dict[int, _Sender] = {}
+        self._joining: set[int] = set()
+        self._suspects: set[int] = set()
+        self._stopped = False
+
+    # -- sender management --
+
+    def _sender(self, bid: int) -> _Sender:
+        with self._lock:
+            s = self._senders.get(bid)
+            if s is None:
+                s = _Sender(self, bid)
+                self._senders[bid] = s
+                s.start()
+            return s
+
+    def sync_members(self) -> None:
+        """Drop senders for brokers neither in the set nor joining."""
+        members = set(self.members_fn())
+        with self._lock:
+            drop = [
+                bid for bid in self._senders
+                if bid not in members and bid not in self._joining
+            ]
+            dropped = [self._senders.pop(bid) for bid in drop]
+        for s in dropped:
+            s.stop()
+
+    def is_joining(self, bid: int) -> bool:
+        with self._lock:
+            return bid in self._joining
+
+    def take_suspects(self) -> set[int]:
+        """Standbys that stalled a round past ack_timeout (the server's
+        duty loop proposes their removal from the set)."""
+        with self._lock:
+            out = self._suspects
+            self._suspects = set()
+            return out
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            senders = list(self._senders.values())
+            self._senders.clear()
+        for s in senders:
+            s.stop()
+
+    # -- hot path (DataPlane resolver thread) --
+
+    def replicate(self, records: list) -> None:
+        """Block until every current-set member acked this round. Raises
+        FencedError if deposed. A member removed from the set mid-wait is
+        skipped; an unreachable member is flagged suspect (duty loop
+        proposes removal) while the wait continues."""
+        targets = set(self.members_fn())
+        with self._lock:
+            targets |= self._joining
+        futs = {bid: self._sender(bid).enqueue(records) for bid in targets}
+        start = time.monotonic()
+        for bid, fut in futs.items():
+            suspected = False
+            while True:
+                if bid not in self.members_fn():
+                    break  # joiner or freshly-removed member: no ack needed
+                try:
+                    fut.result(timeout=0.05)
+                    break
+                except TimeoutError:
+                    if not self.active():
+                        raise FencedError("controller deposed (local metadata)")
+                    if (
+                        not suspected
+                        and time.monotonic() - start > self.ack_timeout_s
+                    ):
+                        suspected = True
+                        with self._lock:
+                            self._suspects.add(bid)
+                except FencedError:
+                    raise
+                except ReplicationError:
+                    if bid in self.members_fn():
+                        # Sender died (replicator stopping) while its
+                        # target is still a member: without this member's
+                        # ack the round may exist nowhere but here — fail
+                        # it. (This is exactly the shutdown race: a
+                        # partitioned controller being stopped must not
+                        # settle its stranded in-flight rounds.)
+                        raise
+                    break  # member left the set: ack no longer required
+
+    # -- catch-up (controller duty worker thread) --
+
+    def catchup(self, bid: int, store, timeout_s: float = 600.0) -> None:
+        """Stream the full local store prefix to a joining broker; returns
+        when the standby holds it. Caller proposes set membership after,
+        then calls finish_join()."""
+        s = self._sender(bid)
+        with self._lock:
+            self._joining.add(bid)
+        s.begin_buffer()
+        last_fut: Optional[Future] = None
+        try:
+            batch: list = []
+            nbytes = 0
+            for rec in store.scan():
+                batch.append(rec)
+                nbytes += len(rec[3])
+                if (
+                    len(batch) >= _CATCHUP_BATCH_RECORDS
+                    or nbytes >= _CATCHUP_BATCH_BYTES
+                ):
+                    last_fut = s.enqueue_catchup(batch)
+                    batch, nbytes = [], 0
+            if batch or last_fut is None:
+                last_fut = s.enqueue_catchup(batch)
+        finally:
+            s.end_buffer()
+        last_fut.result(timeout=timeout_s)
+
+    def finish_join(self, bid: int) -> None:
+        with self._lock:
+            self._joining.discard(bid)

@@ -14,8 +14,15 @@ each (wire/transport dispatches by the "type" field):
   raft.*           ← JRaft's internal traffic (here: hostraft, metadata only)
   engine.*         ← controller-only: data-plane access for peer brokers
                      (the reference needs no equivalent — every JVM broker
-                     holds state; here the device mesh is driven by one
-                     controller process and peers reach it by RPC)
+                     holds state; here the device mesh is driven by the
+                     CURRENT controller and peers reach it by RPC)
+  repl.rounds      ← standby side of committed-round replication: the
+                     controller streams every persisted round to the
+                     metadata-replicated standby set, any member of which
+                     can be promoted on controller death — restoring the
+                     any-broker fault tolerance the reference gets from
+                     per-broker JRaft groups (PartitionRaftServer.java:83-93;
+                     see broker/replication.py)
 
 Leader checks REFUSE with a hint instead of the reference's
 missing-return fallthrough (MessageAppendRequestProcessor.java:29-33 — a
@@ -41,6 +48,7 @@ from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner
 from ripplemq_tpu.broker.manager import (
     OP_REGISTER_CONSUMER,
+    OP_SET_STANDBYS,
     PartitionManager,
 )
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
@@ -72,42 +80,43 @@ class BrokerServer:
         self.broker_id = broker_id
         self.config = config
         self.info = config.broker(broker_id)
-        self.is_controller = broker_id == config.controller
         self._net = net
+        self._engine_mode = engine_mode
         self._duty_interval_s = duty_interval_s
         self._stop = threading.Event()
+        self._started = False
         self.data_dir = data_dir
 
-        # --- engine (controller only owns a device program) ---
-        # With a data_dir, the controller persists committed rounds to a
-        # segment store and replays them on boot (the role JRaft's storage
-        # URIs play for the reference, TopicsRaftServer.java:134-136 —
-        # which the reference only half-uses: its FSMs never snapshot,
-        # SURVEY.md §5).
-        if self.is_controller:
-            if dataplane is not None:
-                self.dataplane = dataplane
-                self._owns_dataplane = False
-            else:
-                store = None
-                if data_dir is not None:
-                    import os
+        # --- committed-round store ---
+        # EVERY broker holds one, so any broker can serve as a replication
+        # standby and take over as controller (broker/replication.py).
+        # Disk-backed under data_dir (the role JRaft's storage URIs play
+        # for the reference, TopicsRaftServer.java:134-136 — which the
+        # reference only half-uses: its FSMs never snapshot, SURVEY.md §5);
+        # in-memory otherwise (matching the reference's own durability for
+        # partition data: process memory + replication,
+        # PartitionStateMachine.java:26-27).
+        self._store_dir = None
+        self._owns_store = dataplane is None
+        if dataplane is not None:
+            self._round_store = dataplane.store  # may be None
+        elif data_dir is not None:
+            import os
 
-                    from ripplemq_tpu.broker.dataplane import recover_image
-                    from ripplemq_tpu.storage.segment import SegmentStore
+            from ripplemq_tpu.storage.erasure import repair_store
+            from ripplemq_tpu.storage.segment import SegmentStore
 
-                    seg_dir = os.path.join(data_dir, "segments")
-                    image = recover_image(config.engine, seg_dir)
-                    store = SegmentStore(seg_dir, erasure=True)
-                self.dataplane = DataPlane(
-                    config.engine, mode=engine_mode, store=store
-                )
-                if data_dir is not None and image is not None:
-                    self.dataplane.install(image)
-                self._owns_dataplane = True
+            self._store_dir = os.path.join(data_dir, "segments")
+            # Heal erasure-protected sealed segments BEFORE opening for
+            # append (a missing/corrupt sealed segment rebuilds from any
+            # 3 of its 5 RS shards).
+            repair_store(self._store_dir)
+            self._round_store = SegmentStore(self._store_dir, erasure=True)
         else:
-            self.dataplane = None
-            self._owns_dataplane = False
+            from ripplemq_tpu.storage.memstore import MemoryRoundStore
+
+            self._round_store = MemoryRoundStore()
+        self._repl_last_flush = 0.0
 
         # --- transports ---
         if net is not None:
@@ -117,8 +126,9 @@ class BrokerServer:
             self.client = TcpClient()
             self._tcp_server = TcpServer(self.info.host, self.info.port, self.dispatch)
 
-        # --- control plane ---
-        self.manager = PartitionManager(broker_id, config, self.dataplane)
+        # --- control plane (the dataplane attaches after, since the
+        # restored metadata decides who the controller is) ---
+        self.manager = PartitionManager(broker_id, config, None)
         persist_fn = None
         if data_dir is not None:
             import os
@@ -154,6 +164,21 @@ class BrokerServer:
         self._alive_horizon = max(
             4, int(config.metadata_election_timeout_s / tick_interval_s)
         )
+
+        # --- engine (the CURRENT controller owns the device program;
+        # controllership is replicated metadata and moves on failover) ---
+        self.dataplane: Optional[DataPlane] = None
+        self._owns_dataplane = False
+        self._replicator = None
+        self._catchup_thread: Optional[threading.Thread] = None
+        if dataplane is not None:
+            self.dataplane = dataplane
+            self.manager.attach_dataplane(dataplane)
+            if dataplane.replicate_fn is None and self._round_store is not None:
+                dataplane.replicate_fn = self._make_replicator().replicate
+        elif self.manager.current_controller() == broker_id:
+            self._boot_dataplane()
+
         self._duty_thread = threading.Thread(
             target=self._duty_loop, daemon=True, name=f"broker-duty-{broker_id}"
         )
@@ -165,10 +190,69 @@ class BrokerServer:
     def addr(self) -> str:
         return self.info.address
 
+    @property
+    def is_controller(self) -> bool:
+        """Whether this broker currently drives the device program (a
+        replicated, epoch-fenced metadata fact — not the static config
+        role it was before controller failover existed)."""
+        return self.manager.current_controller() == self.broker_id
+
+    def _boot_dataplane(self) -> None:
+        """Build the device program from the local committed-round store:
+        the bootstrap path on the config controller and the TAKEOVER path
+        on a promoted standby. Only committed rounds are ever in the
+        store, so the replayed image is a valid post-commit state for
+        every replica slot."""
+        from ripplemq_tpu.broker.dataplane import replay_records
+
+        image = None
+        if self._round_store is not None:
+            image = replay_records(
+                self.config.engine, self._round_store.scan()
+            )
+        dp = DataPlane(
+            self.config.engine, mode=self._engine_mode, store=self._round_store
+        )
+        if image is not None:
+            dp.install(image)
+        if self._round_store is not None:
+            dp.replicate_fn = self._make_replicator().replicate
+        self._owns_dataplane = True
+        self.dataplane = dp
+        self.manager.attach_dataplane(dp)
+        if self._started:
+            dp.start()
+
+    def _make_replicator(self):
+        from ripplemq_tpu.broker.replication import RoundReplicator
+
+        self._replicator = RoundReplicator(
+            self.client,
+            self._addr_of,
+            epoch_fn=self.manager.current_epoch,
+            members_fn=self.manager.current_standbys,
+            active_fn=lambda: (
+                self.manager.current_controller() == self.broker_id
+            ),
+            rpc_timeout_s=min(2.0, self.config.rpc_timeout_s),
+            ack_timeout_s=self.config.rpc_timeout_s,
+        )
+        return self._replicator
+
+    def _local_engine(self) -> Optional[DataPlane]:
+        """The device program, iff this broker is the CURRENT controller
+        (a deposed controller must not serve engine state it no longer
+        replicates — fencing)."""
+        dp = self.dataplane
+        if dp is not None and self.manager.current_controller() == self.broker_id:
+            return dp
+        return None
+
     def _addr_of(self, broker_id: int) -> str:
         return self.config.broker(broker_id).address
 
     def start(self) -> None:
+        self._started = True
         if self._net is not None:
             self._net.register(self.addr, self.dispatch)
         else:
@@ -186,8 +270,12 @@ class BrokerServer:
             self._net.unregister(self.addr)
         else:
             self._tcp_server.stop()
+        if self._replicator is not None:
+            self._replicator.stop()
         if self.dataplane is not None and self._owns_dataplane:
             self.dataplane.stop()
+        if self._owns_store and self._round_store is not None:
+            self._round_store.close()
         self.client.close()
 
     # ------------------------------------------------------------- dispatch
@@ -216,6 +304,8 @@ class BrokerServer:
                 return self._handle_consume(req)
             if t == "offset.commit":
                 return self._handle_offset_commit(req)
+            if t == "repl.rounds":
+                return self._handle_repl_rounds(req)
             if t.startswith("engine."):
                 return self._handle_engine(t, req)
             return {"ok": False, "error": f"unknown request type {t!r}"}
@@ -388,7 +478,7 @@ class BrokerServer:
     # -- engine access (direct on the controller, RPC from peers) ---------
 
     def _controller_addr(self) -> str:
-        return self._addr_of(self.config.controller)
+        return self._addr_of(self.manager.current_controller())
 
     def _engine_call(self, req: dict) -> dict:
         resp = self.client.call(
@@ -404,8 +494,9 @@ class BrokerServer:
         """Returns a waiter so multi-chunk produces pipeline their rounds
         (both paths submit WITHOUT blocking: local futures, or pipelined
         RPC frames when a TcpClient with call_async is underneath)."""
-        if self.dataplane is not None:
-            fut = self.dataplane.submit_append(slot, messages)
+        dp = self._local_engine()
+        if dp is not None:
+            fut = dp.submit_append(slot, messages)
             return lambda: int(fut.result(timeout=self.config.rpc_timeout_s))
         req = {"type": "engine.append", "slot": slot, "messages": messages}
         call_async = getattr(self.client, "call_async", None)
@@ -426,8 +517,9 @@ class BrokerServer:
 
     def _engine_read(self, slot: int, offset: int, replica: int,
                      max_msgs: Optional[int] = None):
-        if self.dataplane is not None:
-            return self.dataplane.read(slot, offset, replica, max_msgs)
+        dp = self._local_engine()
+        if dp is not None:
+            return dp.read(slot, offset, replica, max_msgs)
         resp = self._engine_call(
             {"type": "engine.read", "slot": slot, "offset": offset,
              "replica": replica, "max_msgs": max_msgs}
@@ -435,8 +527,9 @@ class BrokerServer:
         return list(resp["messages"]), int(resp["end"])
 
     def _engine_read_offset(self, slot: int, cslot: int, replica: int = 0) -> int:
-        if self.dataplane is not None:
-            return self.dataplane.read_offset(slot, cslot, replica)
+        dp = self._local_engine()
+        if dp is not None:
+            return dp.read_offset(slot, cslot, replica)
         resp = self._engine_call(
             {"type": "engine.read_offset", "slot": slot, "cslot": cslot,
              "replica": replica}
@@ -444,8 +537,9 @@ class BrokerServer:
         return int(resp["offset"])
 
     def _engine_offsets(self, slot: int, updates: list[tuple[int, int]]) -> None:
-        if self.dataplane is not None:
-            self.dataplane.submit_offsets(slot, updates).result(
+        dp = self._local_engine()
+        if dp is not None:
+            dp.submit_offsets(slot, updates).result(
                 timeout=self.config.rpc_timeout_s
             )
             return
@@ -455,33 +549,62 @@ class BrokerServer:
         )
 
     def _handle_engine(self, t: str, req: dict) -> dict:
-        if self.dataplane is None:
+        dp = self._local_engine()
+        if dp is None:
             return {"ok": False, "error": "not_controller",
                     "controller_addr": self._controller_addr()}
         if t == "engine.append":
-            fut = self.dataplane.submit_append(
+            fut = dp.submit_append(
                 int(req["slot"]), list(req["messages"])
             )
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
         if t == "engine.read":
             limit = req.get("max_msgs")
-            msgs, end = self.dataplane.read(
+            msgs, end = dp.read(
                 int(req["slot"]), int(req["offset"]), int(req["replica"]),
                 None if limit is None else int(limit),
             )
             return {"ok": True, "messages": msgs, "end": end}
         if t == "engine.read_offset":
-            return {"ok": True, "offset": self.dataplane.read_offset(
+            return {"ok": True, "offset": dp.read_offset(
                 int(req["slot"]), int(req["cslot"]),
                 int(req.get("replica", 0)))}
         if t == "engine.offsets":
-            fut = self.dataplane.submit_offsets(
+            fut = dp.submit_offsets(
                 int(req["slot"]), [(int(s), int(o)) for s, o in req["updates"]]
             )
             fut.result(self.config.rpc_timeout_s)
             return {"ok": True}
         return {"ok": False, "error": f"unknown engine op {t!r}"}
+
+    def _handle_repl_rounds(self, req: dict) -> dict:
+        """Standby side of committed-round replication
+        (broker/replication.py). Epoch-fenced: rejecting a stale epoch is
+        what deposes an old controller — its resolver fails the round
+        with FencedError and producers re-route."""
+        epoch = int(req["epoch"])
+        cur = self.manager.current_epoch()
+        if epoch < cur:
+            return {"ok": False, "error": "stale_epoch", "epoch": cur}
+        if (
+            self.dataplane is not None
+            and self.manager.current_controller() == self.broker_id
+        ):
+            # Our metadata lags a newer epoch (or a deposed peer streams
+            # at ours): refuse non-fatally; the sender retries until the
+            # fence duty on one side resolves it.
+            return {"ok": False, "error": "active_controller"}
+        store = self._round_store
+        if store is None:
+            return {"ok": False, "error": "no_store"}
+        for rec_type, slot, base, payload in req["records"]:
+            store.append(int(rec_type), int(slot), int(base), payload)
+        now = time.monotonic()
+        if now - self._repl_last_flush >= 0.05:
+            store.flush()
+            self._repl_last_flush = now
+        return {"ok": True}
 
     # ---------------------------------------------------------------- duty
 
@@ -489,7 +612,10 @@ class BrokerServer:
         while not self._stop.wait(self._duty_interval_s):
             try:
                 self._metadata_leader_duty()
+                self._fence_duty()
+                self._takeover_duty()
                 self._controller_duty()
+                self._standby_duty()
             except Exception as e:  # duties must never kill the loop
                 self.duty_errors.append(f"{type(e).__name__}: {e}")
                 del self.duty_errors[:-20]
@@ -505,16 +631,52 @@ class BrokerServer:
         cmd = self.manager.plan_assignment(alive)
         if cmd is not None:
             self.runner.propose(cmd)
+        # Controller failover: promote a live standby when the controller
+        # is dead; prune dead standbys otherwise.
+        ctrl_cmd = self.manager.plan_controller(alive)
+        if ctrl_cmd is not None:
+            self.runner.propose(ctrl_cmd)
+
+    def _fence_duty(self) -> None:
+        """Deposed controller: release the device program and revert to a
+        plain frontend (its round store keeps its copy of the stream; the
+        new controller re-admits it to the standby set via catch-up)."""
+        if self.dataplane is None or not self._owns_dataplane:
+            return
+        if self.manager.current_controller() == self.broker_id:
+            return
+        dp = self.dataplane
+        self.dataplane = None
+        self.manager.detach_dataplane()
+        if self._replicator is not None:
+            self._replicator.stop()
+            self._replicator = None
+        dp.stop()  # fails queued/in-flight rounds → producers re-route
+        self._owns_dataplane = False
+
+    def _takeover_duty(self) -> None:
+        """Promoted standby: boot the device program from the local copy
+        of the committed-round stream. Every settled round was acked by
+        every standby-set member before its producer saw success, so no
+        committed entry is lost across the handover."""
+        if self.dataplane is not None:
+            return
+        if self.manager.current_controller() != self.broker_id:
+            return
+        if self._round_store is None:
+            return
+        self._boot_dataplane()
 
     def _controller_duty(self) -> None:
-        if self.dataplane is None:
+        dp = self._local_engine()
+        if dp is None:
             return
         # One [R, P] log-ends snapshot per tick, shared by both planners
         # (elections don't move log ends, so the snapshot stays valid).
-        log_ends = self.dataplane.log_ends()
+        log_ends = dp.log_ends()
         cands, drafts = self.manager.plan_elections(log_ends)
         if cands:
-            winners = self.dataplane.elect(cands)
+            winners = dp.elect(cands)
             for slot, won in winners.items():
                 if won:
                     self.propose_cmd(drafts[slot], retries=1)
@@ -522,4 +684,79 @@ class BrokerServer:
         # leader (covers post-election catch-up and slots that came alive
         # while the partition was leaderless).
         for (src, dst), slots in self.manager.plan_repairs(log_ends).items():
-            self.dataplane.resync(src, dst, slots)
+            dp.resync(src, dst, slots)
+
+    def _standby_duty(self) -> None:
+        """Controller: maintain the standby set — drop suspects stalling
+        the settle path, admit new members after catch-up (the join
+        protocol of broker/replication.py)."""
+        rep = self._replicator
+        if rep is None or self._local_engine() is None:
+            return
+        rep.sync_members()
+        suspects = rep.take_suspects()
+        if suspects:
+            members = [
+                s for s in self.manager.current_standbys()
+                if s not in suspects
+            ]
+            self.propose_cmd(
+                {"op": OP_SET_STANDBYS,
+                 "epoch": self.manager.current_epoch(),
+                 "standbys": members},
+                retries=1,
+            )
+        if self._catchup_thread is not None:
+            if self._catchup_thread.is_alive():
+                return
+            self._catchup_thread = None
+        if self._round_store is None:
+            return
+        cand = self.manager.plan_standby_add(self.config.standby_count)
+        if cand is None or rep.is_joining(cand):
+            return
+        t = threading.Thread(
+            target=self._run_catchup, args=(cand,), daemon=True,
+            name=f"catchup-{self.broker_id}-to-{cand}",
+        )
+        self._catchup_thread = t
+        t.start()
+
+    def _run_catchup(self, cand: int) -> None:
+        """Stream the full store prefix to `cand`, then propose its
+        standby-set membership (live rounds buffer behind the scan and
+        flow to the joiner meanwhile, so the stream is gap-free)."""
+        rep = self._replicator
+        epoch = self.manager.current_epoch()
+        joined = False
+        try:
+            rep.catchup(cand, self._round_store)
+            members = sorted(set(self.manager.current_standbys()) | {cand})
+            if self.propose_cmd(
+                {"op": OP_SET_STANDBYS, "epoch": epoch, "standbys": members},
+                retries=10,
+            ):
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    if cand in self.manager.current_standbys():
+                        joined = True
+                        break
+                    if self.manager.current_epoch() != epoch:
+                        return  # deposed mid-join; fence duty cleans up
+                    time.sleep(0.02)
+            if not joined:
+                self.duty_errors.append(f"catchup({cand}): membership "
+                                        "proposal failed; will retry")
+                del self.duty_errors[:-20]
+        except Exception as e:
+            self.duty_errors.append(
+                f"catchup({cand}): {type(e).__name__}: {e}"
+            )
+            del self.duty_errors[:-20]
+        finally:
+            # Success AND failure both leave the joining state: a joined
+            # member now acks via the set; a failed join is fully unwound
+            # (sync_members prunes the sender) so the next duty pass
+            # retries the catch-up from scratch — replay is later-record-
+            # wins, so re-streamed duplicates are harmless.
+            rep.finish_join(cand)

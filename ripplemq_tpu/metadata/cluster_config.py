@@ -34,11 +34,20 @@ class ClusterConfig:
     membership_poll_s: float = 10.0
     metadata_refresh_s: float = 10.0
     rpc_timeout_s: float = 3.0
-    # The broker that drives the TPU mesh (device-program controller).
-    # None → lowest broker id. The reference has no such role — every JVM
-    # broker replicates; here the data plane is a single SPMD program and
-    # the other brokers are serving/metadata frontends reaching it by RPC.
+    # The broker that BOOTSTRAPS as the TPU mesh driver (device-program
+    # controller). None → lowest broker id. The reference has no such
+    # role — every JVM broker replicates; here the data plane is a single
+    # SPMD program and the other brokers are serving/metadata frontends
+    # reaching it by RPC. At runtime controllership is a replicated,
+    # epoch-fenced metadata fact that MOVES on controller death
+    # (broker/replication.py): the controller streams its committed
+    # rounds to `standby_count` standby brokers, any of which the
+    # metadata leader can promote.
     controller_id: int | None = None
+    # How many standby brokers hold a full copy of the committed-round
+    # stream (the data plane survives the loss of the controller plus
+    # standby_count - 1 standbys). 0 disables controller failover.
+    standby_count: int = 2
 
     @property
     def controller(self) -> int:
@@ -117,4 +126,6 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
     extra = {k: float(raw[k]) for k in timing_keys if k in raw}
     if raw.get("controller_id") is not None:
         extra["controller_id"] = int(raw["controller_id"])
+    if "standby_count" in raw:
+        extra["standby_count"] = int(raw["standby_count"])
     return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **extra)

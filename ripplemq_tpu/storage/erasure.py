@@ -16,6 +16,12 @@ Layout: shards of `segment-XXXXXXXX.log` live in `<store>/rs/` as
 k+i is parity i. Each shard file carries its own CRC plus the CRC of the
 whole original segment, so repair can tell a stale shard set from a
 usable one.
+
+Protection window note: protect_store treats shard-file PRESENCE of a
+complete set as protected without re-reading shard CRCs (a full CRC scrub
+per flush would defeat the off-path design), so a shard that rots on disk
+silently lowers that segment's loss tolerance below m until the next
+boot-time repair_store pass validates and rewrites it.
 """
 
 from __future__ import annotations
@@ -204,16 +210,21 @@ def repair_store(store_dir: str, **kw) -> list[str]:
     repaired = []
     for name in sorted(_protected_names(store_dir)):
         seg_path = os.path.join(store_dir, name)
-        meta = None
+        # The health check must use a CONSISTENT shard generation: a stale
+        # straggler shard must not mark a healthy segment unhealthy
+        # (reconstruct_segment refuses mixed generations anyway), so
+        # require every valid shard to agree on (orig_len, data_crc).
+        gens: set[tuple[int, int]] = set()
         valid_shards = 0
         for path in shard_paths(store_dir, name):
             got = _read_shard(path)
             if got is not None:
-                meta = got
+                _, o, c, _ = got
+                gens.add((o, c))
                 valid_shards += 1
-        if meta is None:
-            continue  # shard set itself is dead; nothing to do
-        _, orig_len, data_crc, _ = meta
+        if len(gens) != 1:
+            continue  # dead or mixed-generation shard set; scanner handles it
+        orig_len, data_crc = next(iter(gens))
         try:
             with open(seg_path, "rb") as f:
                 raw = f.read()
@@ -242,6 +253,9 @@ def repair_store(store_dir: str, **kw) -> list[str]:
             # block recovery.
             try:
                 encode_segment(store_dir, name, **kw)
-            except OSError:
+            except Exception:
+                # encode runs device kernels (rs_encode), so non-OSError
+                # failures (JAX/XLA runtime errors) are possible too —
+                # never let derived data block recovery/boot.
                 pass
     return repaired

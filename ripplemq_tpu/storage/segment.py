@@ -216,6 +216,15 @@ class SegmentStore:
         if t is not None and t.is_alive():
             t.join(timeout)
 
+    def scan(self) -> Iterator[tuple[int, int, int, bytes]]:
+        """Records in write order (see scan_store). Safe to call while the
+        store is open for append: records written after the scan reaches
+        the tail may be missed (a concurrently-written tail record reads
+        as torn and ends the scan), never misread — callers that need a
+        consistent prefix must order themselves against append (see
+        broker/replication.py catch-up protocol)."""
+        return scan_store(self.directory)
+
     def close(self) -> None:
         with self._lock:
             if self._handle is not None:
@@ -229,8 +238,14 @@ class SegmentStore:
         if self.erasure:
             # Orderly shutdown: finish protection synchronously (the
             # background worker may be mid-encode or rate-limited out).
+            # If the worker is STILL alive after the join timeout, skip
+            # the synchronous run — two unsynchronized encoders would
+            # race on the same shard .tmp paths; the straggler finishes
+            # the job (or the next boot's repair pass does).
             self.wait_erasure(timeout=30)
-            self._erasure_worker()
+            t = self._erasure_thread
+            if t is None or not t.is_alive():
+                self._erasure_worker()
 
 
 def scan_store(
