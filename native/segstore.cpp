@@ -153,8 +153,12 @@ void* segstore_open(const char* dir, long segment_bytes) {
   return s;
 }
 
-int segstore_append(void* h, int type, int slot, int base,
-                    const uint8_t* data, int len) {
+// Appends one framed record; reports the segment index and the byte
+// offset of the PAYLOAD within that segment file (the retention read
+// path serves lagging consumers straight from these positions).
+int segstore_append_at(void* h, int type, int slot, int base,
+                       const uint8_t* data, int len,
+                       int* out_seg, long* out_off) {
   Store* s = static_cast<Store*>(h);
   if (!s || s->fd < 0 || len < 0) return -1;
   if (s->seg_size + (long)(kHeader + len) > s->segment_bytes && s->seg_size > 0) {
@@ -170,9 +174,16 @@ int segstore_append(void* h, int type, int slot, int base,
   put_u32(&frame[13], (uint32_t)len);
   put_u32(&frame[17], crc32_of(data, (size_t)len));
   if (len) memcpy(&frame[kHeader], data, (size_t)len);
+  if (out_seg) *out_seg = s->seg_index;
+  if (out_off) *out_off = s->seg_size + (long)kHeader;
   if (write_all(s->fd, frame.data(), frame.size()) != 0) return -1;
   s->seg_size += (long)frame.size();
   return 0;
+}
+
+int segstore_append(void* h, int type, int slot, int base,
+                    const uint8_t* data, int len) {
+  return segstore_append_at(h, type, slot, base, data, len, nullptr, nullptr);
 }
 
 int segstore_flush(void* h) {

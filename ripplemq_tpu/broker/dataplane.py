@@ -33,7 +33,7 @@ import time
 
 from ripplemq_tpu.core.config import ALIGN, EngineConfig
 from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
-from ripplemq_tpu.core.state import ReplicaState, StepInput, init_state
+from ripplemq_tpu.core.state import ReplicaState, StepInput, init_state, row_lens
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh
 from ripplemq_tpu.storage.segment import (
@@ -49,7 +49,22 @@ class NotCommittedError(Exception):
 
 
 class PartitionFullError(NotCommittedError):
-    """The partition's log has no room for the batch (backpressure)."""
+    """The partition's log has no room for the batch (backpressure).
+
+    Only reachable in store-less (pure in-memory) deployments: with a
+    round store attached, the device ring recycles rows below the trim
+    watermark (everything committed is already persisted — the store is
+    the log of record) and appends never wedge; lagging consumers are
+    served from the store via the log index."""
+
+
+# Device offsets (log_end/commit/trim) are int32 — the TPU-native scalar
+# width (int64 is emulated). A partition appending past 2^31 rows would
+# wrap negative and silently corrupt capacity/commit/read arithmetic, so
+# submits are refused with a clean error well before the edge (at
+# slot_bytes=128 the horizon is 256 GiB through ONE partition; spread
+# load over more partitions to go past it).
+_OFFSET_HORIZON = (1 << 31) - (1 << 20)
 
 
 class _Pending:
@@ -95,6 +110,24 @@ class DataPlane:
         self.store = store
         self.flush_interval_s = flush_interval_s
         self._last_flush = 0.0
+        # Retention (see core.state ring doc): `trim[p]` is the absolute
+        # watermark below which device ring rows are reclaimable — raised
+        # lazily by _drain when a partition needs room, never above the
+        # persisted prefix. `_log_end[p]` is the host's shadow of the
+        # leader's absolute log end (exact while the slot is not busy:
+        # one in-flight round per slot, advanced at resolve time).
+        # `log_index` maps (slot, offset) → store record so reads below
+        # trim are served from the store (storage/logindex.py).
+        P0 = cfg.partitions
+        self.trim = np.zeros((P0,), np.int64)
+        self._log_end = np.zeros((P0,), np.int64)
+        self.log_index = None
+        if store is not None and hasattr(store, "scan_indexed"):
+            from ripplemq_tpu.storage.logindex import LogIndex
+
+            self.log_index = LogIndex()
+            self.log_index.load(store.scan_indexed(), cfg.slot_bytes,
+                                REC_APPEND)
         # Controller-failover hook: called with each round's committed
         # records AFTER local persistence and BEFORE settling futures —
         # the resolver blocks until the standby set acked, so a settled
@@ -272,6 +305,15 @@ class DataPlane:
                 )
                 return fut
         with self._lock:
+            if self._log_end[slot] >= _OFFSET_HORIZON:
+                fut.set_exception(
+                    PartitionFullError(
+                        f"partition {slot} reached the int32 offset horizon "
+                        f"({_OFFSET_HORIZON} rows); re-key onto another "
+                        f"partition"
+                    )
+                )
+                return fut
             self._appends.setdefault(slot, []).append(
                 _Pending(list(payloads), fut, self.max_retry_rounds)
             )
@@ -323,12 +365,39 @@ class DataPlane:
         `offset + len(messages)`. Replica-local, no quorum round —
         matching the reference's leader-local reads
         (PartitionStateMachine.handleBatchRead:85) but bounded by the
-        commit index (stricter: never serves un-replicated entries)."""
-        with self._device_lock:
-            data, lens, count = self.fns.read(
-                self._state, np.int32(replica), np.int32(slot), np.int32(offset)
-            )
-            with_pos = decode_entries_with_pos(data, lens, count)
+        commit index (stricter: never serves un-replicated entries).
+
+        Offsets below the retention watermark are served from the round
+        store via the log index (only committed rounds are ever
+        persisted, so store reads need no commit bound); once the
+        consumer's position climbs back above the watermark, reads come
+        from the device ring again. A ring read races the step thread —
+        trim can advance and a committed round can recycle the window's
+        rows between the watermark check and the device read — so the
+        watermark is re-checked AFTER the read and a covered window is
+        re-served from the store (store records are immutable, so that
+        path is race-free)."""
+        if not 0 <= slot < self.cfg.partitions:
+            raise ValueError(f"partition slot {slot} out of range")
+        while True:
+            with self._lock:
+                trim = int(self.trim[slot])
+            if offset < trim and self.log_index is not None:
+                got = self._read_store(slot, offset, max_msgs)
+                if got is not None:
+                    return got
+            with self._device_lock:
+                data, lens, count = self.fns.read(
+                    self._state, np.int32(replica), np.int32(slot),
+                    np.int32(offset)
+                )
+                with_pos = decode_entries_with_pos(data, lens, count)
+            with self._lock:
+                trim_after = int(self.trim[slot])
+            if trim_after <= offset or self.log_index is None:
+                break
+            # trim advanced past this window mid-read: its ring rows may
+            # hold the next lap now — retry (next pass store-serves).
         count = int(count)
         if max_msgs is not None and len(with_pos) > max(0, max_msgs):
             with_pos = with_pos[: max(0, max_msgs)]
@@ -336,6 +405,39 @@ class DataPlane:
             next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
         else:
             next_offset = offset + count
+        return [m for _, m in with_pos], next_offset
+
+    def _read_store(
+        self, slot: int, offset: int, max_msgs: Optional[int]
+    ) -> Optional[tuple[list[bytes], int]]:
+        """Serve one read below the retention watermark from the round
+        store: find the append record holding `offset` (or the next one —
+        a consumer below the earliest retained record jumps forward, the
+        documented earliest-reset semantics), seek-read its rows, decode.
+        Serves from ONE record per call; the caller's next_offset loop
+        walks forward and falls back to the device ring once past the
+        watermark. Returns None if nothing is indexed at-or-after offset
+        (caller falls through to the ring)."""
+        SB = self.cfg.slot_bytes
+        entry = self.log_index.find(slot, offset)
+        if entry is None:
+            return None
+        base, nrows, locator = entry
+        if offset < base:
+            offset = base  # jumped to the earliest retained record
+        row = offset - base
+        k = min(nrows - row, self.cfg.read_batch)
+        if k <= 0:
+            return None
+        data = self.store.read_payload(locator, row * SB, k * SB)
+        rows = np.frombuffer(data, np.uint8).reshape(k, SB)
+        lens = np.asarray(row_lens(rows))  # one header decoder (core.state)
+        with_pos = decode_entries_with_pos(rows, lens, k)
+        if max_msgs is not None and len(with_pos) > max(0, max_msgs):
+            with_pos = with_pos[: max(0, max_msgs)]
+            next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
+        else:
+            next_offset = offset + k
         return [m for _, m in with_pos], next_offset
 
     def read_offset(self, slot: int, consumer_slot: int, replica: int = 0) -> int:
@@ -407,13 +509,30 @@ class DataPlane:
             round_appends: dict[int, list[tuple[_Pending, int, int]]] = {}
             round_offsets: dict[int, list[_PendingOffsets]] = {}
 
+            S = cfg.slots
+            can_trim = self.store is not None and self.log_index is not None
             for slot, queue in list(self._appends.items()):
                 if slot in self._busy_a:
                     continue  # one in-flight round per slot (ordering)
+                end = int(self._log_end[slot])
+                if can_trim:
+                    # Lazy retention: raise the trim watermark just enough
+                    # for a full window past the current end. Everything
+                    # below `end` is persisted (the slot is not busy), so
+                    # trimmed rows remain servable from the store.
+                    needed = end + B - S
+                    if needed > self.trim[slot]:
+                        self.trim[slot] = needed
+                    # Rounds must never lap the ring boundary (live rows
+                    # would land in the wrap margin): cap this round's
+                    # batch at the rows left before the boundary.
+                    cap = min(B, S - end % S)
+                else:
+                    cap = B  # store-less: bounded log, old behavior
                 taken: list[tuple[_Pending, int, int]] = []
                 fill = 0
                 batch: list[bytes] = []
-                while queue and fill + len(queue[0].payloads) <= B:
+                while queue and fill + len(queue[0].payloads) <= cap:
                     pend = queue.pop(0)
                     n = len(pend.payloads)
                     taken.append((pend, fill, n))
@@ -423,6 +542,15 @@ class DataPlane:
                     entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
                     counts[slot] = fill
                     round_appends[slot] = taken
+                elif queue and can_trim:
+                    # The queue head cannot fit before the ring boundary:
+                    # submit a boundary-padding round (length-0 rows carry
+                    # the term; decode skips them) so the next round
+                    # starts the lap at ring position 0.
+                    pad = S - end % S  # < B here (head <= B did not fit)
+                    entries[slot] = pack_rows(cfg, [], int(self.term[slot]))
+                    counts[slot] = pad
+                    round_appends[slot] = []
                 if not queue:
                     self._appends.pop(slot, None)
 
@@ -457,8 +585,9 @@ class DataPlane:
             )
             alive = self.alive.copy()
             quorum = self.quorum.copy()
+            trim = self.trim.astype(np.int32)
         return inp, {"appends": round_appends, "offsets": round_offsets,
-                     "alive": alive, "quorum": quorum}
+                     "alive": alive, "quorum": quorum, "trim": trim}
 
     def _run(self) -> None:
         """Step thread: drain → dispatch → hand off to the resolver."""
@@ -488,7 +617,8 @@ class DataPlane:
                 inp, ctx = work
                 with self._device_lock:
                     self._state, out = self.fns.step(
-                        self._state, inp, ctx["alive"], ctx["quorum"]
+                        self._state, inp, ctx["alive"], ctx["quorum"],
+                        ctx["trim"],
                     )
                 self.rounds += 1
                 for leaf in (out.base, out.committed):
@@ -534,6 +664,14 @@ class DataPlane:
             committed = np.asarray(out.committed)
             records = self._round_records(inp, ctx, base, committed)
             self._persist_round(records)
+            # Advance the absolute-log-end shadow for this round's
+            # committed appends (exact: one in-flight round per slot).
+            counts = np.asarray(inp.counts)
+            with self._lock:
+                for slot in ctx["appends"]:
+                    if committed[slot] and counts[slot] > 0:
+                        adv = -(-int(counts[slot]) // ALIGN) * ALIGN
+                        self._log_end[slot] = int(base[slot]) + adv
             if self.replicate_fn is not None and records:
                 self.replicate_fn(records)
             self._settle(ctx, base, committed)
@@ -566,18 +704,32 @@ class DataPlane:
         return records
 
     def _persist_round(self, records) -> None:
-        """Frame this round's committed records into the segment store."""
+        """Frame this round's committed records into the segment store
+        and index the append records for the retention read path."""
         if self.store is None or not records:
             return
         for rec_type, slot, base, payload in records:
-            self.store.append(rec_type, slot, base, payload)
+            locator = self.store.append(rec_type, slot, base, payload)
+            if rec_type == REC_APPEND and self.log_index is not None:
+                self.log_index.add(
+                    slot, base, len(payload) // self.cfg.slot_bytes, locator
+                )
         now = time.monotonic()
         if now - self._last_flush >= self.flush_interval_s:
             self.store.flush()
             self._last_flush = now
 
     def install(self, image: ReplicaState) -> None:
-        """Install a recovered single-replica image (see recover_image)."""
+        """Install a recovered single-replica image (see recover_image).
+        Re-derives the retention tables: the replayed ring holds at most
+        the last `slots` rows per partition, so anything below
+        `log_end - slots` is store-only (replay writes exactly the rows
+        each record carried — no full-window clobber — hence everything
+        ring-resident is intact and servable)."""
+        ends = np.asarray(image.log_end, np.int64)
+        with self._lock:
+            self._log_end = ends.copy()
+            self.trim = np.maximum(0, ends - self.cfg.slots)
         with self._device_lock:
             self._state = self.fns.init_from(image)
 
@@ -602,11 +754,16 @@ class DataPlane:
                         pend.future.set_result(int(base[slot]) + start)
             else:
                 # Distinguish permanent backpressure (log full) from a
-                # transient quorum outage: the write phase needs a full
-                # max_batch window past the leader's log end (base), so
-                # base + B > slots means no retry can ever fit.
+                # transient quorum outage. Only index-less deployments
+                # (no store, or a store the drain cannot trim against)
+                # can fill permanently: the write phase needs a full
+                # max_batch window past the leader's log end and nothing
+                # is ever trimmed, so base + B > slots means no retry can
+                # ever fit. With a log index the drain raises trim and
+                # retries commit.
                 full = (
-                    base[slot] + self.cfg.max_batch > self.cfg.slots
+                    self.log_index is None
+                    and base[slot] + self.cfg.max_batch > self.cfg.slots
                     and base[slot] > 0
                 )
                 for pend, _, _ in taken:
@@ -680,9 +837,14 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
     (the standby missed an unsettled round the deposed controller
     persisted locally). Both only ever affect rows whose producers were
     NEVER acked; zero rows read back as alignment padding.
+
+    Record bases are ABSOLUTE storage offsets; rows land at their ring
+    positions (base % slots), so a partition that wrapped the ring many
+    times replays to exactly the last `slots` rows — older rows stay
+    store-only, served through the log index (core.state ring doc).
     """
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
-    log_data = np.zeros((P, S, SB), np.uint8)
+    log_data = np.zeros((P, S + cfg.max_batch, SB), np.uint8)
     log_end = np.zeros((P,), np.int32)
     last_term = np.zeros((P,), np.int32)
     commit = np.zeros((P,), np.int32)
@@ -702,9 +864,13 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
                 )
             rows = np.frombuffer(payload, np.uint8).reshape(-1, SB)
             n = rows.shape[0]
-            if base + n > S:
-                raise ValueError(f"replayed round exceeds slots ({base}+{n}>{S})")
-            log_data[slot, base : base + n] = rows
+            pos = base % S
+            if pos + n > S:
+                raise ValueError(
+                    f"replayed round laps the ring ({base}%{S}+{n}>{S}; "
+                    f"store written under a different config?)"
+                )
+            log_data[slot, pos : pos + n] = rows
             log_end[slot] = base + n
             commit[slot] = base + n
             last_term[slot] = int(

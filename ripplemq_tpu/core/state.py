@@ -14,6 +14,22 @@ Row format: every log slot is `slot_bytes` of uint8 with an embedded
 (see core.config.ROW_HEADER). One array holds everything the Raft log
 needs, so the append write phase is ONE DMA per (replica, partition).
 
+Ring retention: `log_end` and `commit` are MONOTONE absolute storage
+offsets; the physical log holds the last `slots` rows as a ring (row for
+absolute offset `a` lives at physical row `a % slots`) plus a
+`max_batch`-row margin so the append DMA's fixed [B, SB] window never
+wraps (rows landing in the margin are always beyond the round's advance —
+dead padding that no read ever selects). Overwriting ring rows is gated
+by a host-fed `trim` watermark (see step.replica_control): rows below
+`trim` are reclaimable because the host has already persisted them to the
+segment store (the disk is the log of record; the device ring is the hot
+serving window). The reference instead grows partition state without
+bound in JVM heap (PartitionStateMachine.java:26-27) — bounded HBM +
+unbounded disk strictly dominates that over time. Offsets are int32 (the
+TPU-native scalar width); the host refuses appends near the 2^31-row
+per-partition horizon (broker.dataplane._OFFSET_HORIZON) rather than
+letting them wrap.
+
 Axis conventions (see EngineConfig):
   P = partitions, R = replicas, S = log slots, SB = slot bytes,
   B = append batch, C = consumer table width, U = offset-update batch.
@@ -38,14 +54,15 @@ from ripplemq_tpu.core.config import EngineConfig
 class ReplicaState(NamedTuple):
     """Per-replica data-plane state (one replica's view of P partitions)."""
 
-    log_data: jax.Array     # uint8 [P, S, SB] — slotted rows (header+payload)
-    log_end: jax.Array      # int32 [P]        — next slot to append (ALIGN-padded)
+    log_data: jax.Array     # uint8 [P, S+B, SB] — ring rows + margin (see module doc)
+    log_end: jax.Array      # int32 [P]        — next ABSOLUTE storage offset (ALIGN-padded)
     last_term: jax.Array    # int32 [P]        — term of the tail row (cached
     #                         prevLogTerm: maintained by every committed
     #                         round, travels with resync copies; avoids a
     #                         per-round row gather)
     current_term: jax.Array  # int32 [P]       — latest term this replica has seen
-    commit: jax.Array       # int32 [P]        — commit index (slots [0, commit) durable)
+    commit: jax.Array       # int32 [P]        — commit index (absolute offsets
+    #                         [trim, commit) are committed and ring-resident)
     offsets: jax.Array      # int32 [P, C]     — replicated consumer offsets
 
 
@@ -86,7 +103,7 @@ def init_state(cfg: EngineConfig) -> ReplicaState:
     """Zero state for one replica."""
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
     return ReplicaState(
-        log_data=jnp.zeros((P, S, SB), jnp.uint8),
+        log_data=jnp.zeros((P, S + cfg.max_batch, SB), jnp.uint8),
         log_end=jnp.zeros((P,), jnp.int32),
         last_term=jnp.zeros((P,), jnp.int32),
         current_term=jnp.zeros((P,), jnp.int32),

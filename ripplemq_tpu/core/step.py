@@ -100,6 +100,7 @@ def replica_control(
     rep_idx: jax.Array,   # int32 scalar — this replica's id on the axis
     alive: jax.Array,     # bool [R] or [P, R] — membership mask (replicated)
     quorum: jax.Array | None = None,  # int32 [P] — per-partition quorum
+    trim: jax.Array | None = None,    # int32 [P] — retention watermark
 ) -> tuple[ReplicaState, ControlOut]:
     """One round's control phase from one replica's point of view: the
     ballot and all scalar-state updates. The returned state has every
@@ -109,11 +110,22 @@ def replica_control(
     replication factors than the mesh's replica-axis size: a partition
     with RF 3 on an R=5 program commits at 2 acks, with its two unused
     slots permanently masked dead in `alive`.
+
+    `trim` is the host's retention watermark (absolute offset, identical
+    on every replica — it rides the round input like `alive`): ring rows
+    holding offsets below `trim` are reclaimable, so a round fits iff its
+    full B-row window only ever lands on free-or-reclaimable rows
+    (`base + B - trim <= S`). Host contracts: trim is monotone per
+    partition, never exceeds the persisted/committed prefix, and the
+    host clamps each round's batch so `advance <= S - (base % S)` (live
+    rows never land in the wrap margin — see core.state ring doc).
     """
     S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
     P = cfg.partitions
     if quorum is None:
         quorum = jnp.full((P,), cfg.quorum, jnp.int32)
+    if trim is None:
+        trim = jnp.zeros((P,), jnp.int32)
 
     # Sanitize host-fed control values: an out-of-range index is undefined
     # behavior on TPU gathers (observed: backend InvalidArgument), and an
@@ -150,12 +162,14 @@ def replica_control(
     log_match = (state.log_end == base) & (
         (base == 0) | (state.last_term == leader_last_term)
     )
-    # Capacity: the write phase always lands a full B-row window, so the
-    # whole window must fit (up to B-1 tail slots go unused — documented
-    # backpressure bias). Offsets-only rounds (counts == 0) consume no
+    # Capacity: the write phase always lands a full B-row window on the
+    # ring, which (previous lap) covers absolute offsets
+    # [base - S, base + B - S) — all of which must be below the trim
+    # watermark. With trim pinned at 0 this reduces to the bounded-log
+    # rule base + B <= S. Offsets-only rounds (counts == 0) consume no
     # log space and must keep committing on a full partition: consumers
     # still need to advance their positions through the backlog.
-    capacity_ok = (counts == 0) | (base + B <= S)
+    capacity_ok = (counts == 0) | (base + B - trim <= S)
     # A round is ack-worthy if it carries entries OR offset commits: offset
     # commits on idle partitions must still replicate (the reference routes
     # them through the partition Raft log regardless of appends).
@@ -220,6 +234,7 @@ def replica_step(
     rep_idx: jax.Array,
     alive: jax.Array,
     quorum: jax.Array | None = None,
+    trim: jax.Array | None = None,
 ) -> tuple[ReplicaState, StepOutput]:
     """Complete per-replica round: control phase + per-replica XLA append.
 
@@ -227,13 +242,15 @@ def replica_step(
     any backend, e.g. the driver's single-chip compile check). The engine
     wrappers instead run `replica_control` under vmap/shard_map and hand
     the write phase to the batched Pallas kernel (ops.append) — same
-    semantics, asserted by tests.
+    semantics, asserted by tests. The write lands at the PHYSICAL ring
+    position `base % slots` (base itself is absolute).
     """
-    new_state, ctl = replica_control(cfg, state, inp, rep_idx, alive, quorum)
+    new_state, ctl = replica_control(cfg, state, inp, rep_idx, alive, quorum,
+                                     trim)
     from ripplemq_tpu.ops.append import append_rows_xla  # local: avoid cycle
 
     log_data = append_rows_xla(
-        state.log_data, inp.entries, ctl.out.base, ctl.do_write
+        state.log_data, inp.entries, ctl.out.base % cfg.slots, ctl.do_write
     )
     return new_state._replace(log_data=log_data), ctl.out
 
@@ -300,24 +317,42 @@ def read_batch(
     (PartitionStateMachine.handleBatchRead:85 — leader-local, no
     read-index), but unlike the reference it only exposes rows below the
     commit index.
+
+    `offset` is an ABSOLUTE storage offset; the physical row of offset
+    `a` is `a % slots` (ring — see core.state). The read window may wrap
+    the ring end, so rows are blended from two windows: [pos, pos+RB)
+    (clamped+rolled) and the ring head [0, RB). Host contract: offset is
+    at least the host's trim watermark — ring rows below trim may have
+    been reclaimed (the host serves those from the segment store).
     """
-    RB = cfg.read_batch
+    RB, S = cfg.read_batch, cfg.slots
+    SP = S + cfg.max_batch  # physical rows incl. wrap margin
     partition = jnp.clip(partition, 0, cfg.partitions - 1)
     commit = state.commit[partition]
-    start = jnp.clip(offset, 0, cfg.slots)
+    start = jnp.maximum(offset, 0)
     count = jnp.clip(commit - start, 0, RB)
-    # dynamic_slice clamps the start so the window fits; compensate by
-    # slicing at a clamped start and rolling the wanted rows to the front
-    # (count never exceeds RB - shift, so rolled-in garbage is masked out).
-    sl_start = jnp.clip(start, 0, cfg.slots - RB)
-    shift = start - sl_start
-    rows = lax.dynamic_slice(
+    pos = start % S
+    # Window A: physical [pos, pos+RB). dynamic_slice clamps the start so
+    # the window fits; compensate by slicing at a clamped start and
+    # rolling the wanted rows to the front.
+    sl_start = jnp.clip(pos, 0, SP - RB)
+    shift = pos - sl_start
+    rows_a = lax.dynamic_slice(
         state.log_data,
         (partition, sl_start, 0),
         (1, RB, cfg.slot_bytes),
     )[0]
-    rows = jnp.roll(rows, -shift, axis=0)
-    valid = jnp.arange(RB, dtype=jnp.int32) < count
+    rows_a = jnp.roll(rows_a, -shift, axis=0)
+    # Window B: ring head [0, RB) — serves row i when pos + i wraps past
+    # the ring end (margin rows are never live; see core.state).
+    rows_b = lax.dynamic_slice(
+        state.log_data, (partition, 0, 0), (1, RB, cfg.slot_bytes)
+    )[0]
+    wrap_at = S - pos  # first window-index served from the ring head
+    rows_b = jnp.roll(rows_b, wrap_at, axis=0)  # b[i] = head[i - wrap_at]
+    i = jnp.arange(RB, dtype=jnp.int32)
+    rows = jnp.where((i < wrap_at)[:, None], rows_a, rows_b)
+    valid = i < count
     rows = jnp.where(valid[:, None], rows, 0)
     lens = jnp.where(valid, row_lens(rows), 0)
     return rows, lens, count

@@ -22,8 +22,12 @@ Semantics contract (shared with the XLA fallback, asserted in tests):
 - the FULL B-row window is written whenever do_write[r, p]; rows at index
   >= count carry length-0 headers (alignment padding) and the next
   committed round overwrites whatever padding trails its own base;
-- callers guarantee base[p] % ALIGN == 0 and base[p] + B <= S whenever
-  do_write[r, p] (the control phase's capacity rule).
+- `base` is the PHYSICAL ring position (absolute log end mod cfg.slots;
+  the engine wrappers compute it) — callers guarantee base[p] % ALIGN == 0
+  and base[p] + B <= S_phys (the log array's row count, which is
+  cfg.slots + the B-row wrap margin; see core.state) whenever
+  do_write[r, p]. The control phase's trim-gated capacity rule keeps
+  live rows out of the window's reclaimable tail.
 """
 
 from __future__ import annotations
@@ -85,9 +89,9 @@ def _append_pallas(log_data, entries, base, do_write, *, interpret=False):
         grid=(R, P // K),
         in_specs=[
             pl.BlockSpec((K, BA, ALIGN, SB), lambda r, c, *_: (c, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA((K,))],
     )
     out = pl.pallas_call(

@@ -95,25 +95,29 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
 
     vctrl = jax.vmap(
         functools.partial(core_step.replica_control, cfg),
-        in_axes=(0, None, 0, None, None),
+        in_axes=(0, None, 0, None, None, None),
         axis_name=core_step.AXIS,
     )
+    default_trim = jnp.zeros((cfg.partitions,), jnp.int32)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _step_j(state, inp: StepInput, alive, quorum):
+    def _step_j(state, inp: StepInput, alive, quorum, trim):
         # Control phase per replica (vmapped), then ONE batched write phase
-        # on the full [R, P, S, SB] log (Pallas DMA kernel on TPU).
-        new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum)
+        # on the full [R, P, S+B, SB] ring (Pallas DMA kernel on TPU; the
+        # window lands at the physical ring position base % slots).
+        new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum, trim)
         log_data = append_rows(
-            state.log_data, inp.entries, ctl.out.base[0], ctl.do_write
+            state.log_data, inp.entries, ctl.out.base[0] % cfg.slots,
+            ctl.do_write
         )
         new_state = new_state._replace(log_data=log_data)
         # outputs are replica-invariant after the psum; take replica 0's copy
         return new_state, jax.tree.map(lambda x: x[0], ctl.out)
 
-    def _step(state, inp, alive, quorum=None):
+    def _step(state, inp, alive, quorum=None, trim=None):
         return _step_j(state, inp, alive,
-                       default_quorum if quorum is None else quorum)
+                       default_quorum if quorum is None else quorum,
+                       default_trim if trim is None else trim)
 
     vvote = jax.vmap(
         functools.partial(core_step.vote_step, cfg),
@@ -223,15 +227,18 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
 
     default_quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
 
+    default_trim = jnp.zeros((cfg.partitions,), jnp.int32)
+
     # ---- step -------------------------------------------------------------
-    def step_body(state, inp, rep, alive, quorum):
+    def step_body(state, inp, rep, alive, quorum, trim):
         st = _squeeze(state)          # strip the size-1 replica block dim
         new_st, ctl = core_step.replica_control(
-            cfg, st, inp, rep[0], alive, quorum
+            cfg, st, inp, rep[0], alive, quorum, trim
         )
-        # Write phase on this device's [1, P_local, S, SB] log block.
+        # Write phase on this device's [1, P_local, S+B, SB] ring block.
         log_data = append_rows(
-            st.log_data[None], inp.entries, ctl.out.base, ctl.do_write[None]
+            st.log_data[None], inp.entries, ctl.out.base % cfg.slots,
+            ctl.do_write[None]
         )
         new_st = new_st._replace(log_data=log_data[0])
         return _expand(new_st), ctl.out  # out is psum-replicated over "replica"
@@ -239,17 +246,20 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     smapped_step = _shard_map(
         step_body,
         mesh=mesh,
-        in_specs=(st_specs, in_specs, P("replica"), P("part", None), P("part")),
+        in_specs=(st_specs, in_specs, P("replica"), P("part", None), P("part"),
+                  P("part")),
         out_specs=(st_specs, StepOutput(P("part"), P("part"), P("part"), P("part"))),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def _step_j(state, inp, alive, quorum):
-        return smapped_step(state, inp, rep_ids, _norm_alive(alive), quorum)
+    def _step_j(state, inp, alive, quorum, trim):
+        return smapped_step(state, inp, rep_ids, _norm_alive(alive), quorum,
+                            trim)
 
-    def _step(state, inp, alive, quorum=None):
+    def _step(state, inp, alive, quorum=None, trim=None):
         return _step_j(state, inp, alive,
-                       default_quorum if quorum is None else quorum)
+                       default_quorum if quorum is None else quorum,
+                       default_trim if trim is None else trim)
 
     # ---- vote -------------------------------------------------------------
     def vote_body(state, cand, cand_term, rep, alive, quorum):

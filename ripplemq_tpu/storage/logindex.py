@@ -1,0 +1,80 @@
+"""Per-partition index of committed append records in the round store.
+
+The device ring (core.state) only holds the last `slots` rows per
+partition; rows trimmed off the ring live on in the round store — the
+log of record. This index maps (partition slot, absolute storage offset)
+to the record that holds the row, so the broker can serve lagging or
+newly-attached consumers from disk with one seek instead of a framing
+walk (the reference never needs this path because it retains everything
+in JVM heap, PartitionStateMachine.java:26-27 — and grows without bound
+for it; SURVEY.md §5 long-axis scaling).
+
+One entry per committed append round: (base, nrows, locator). `locator`
+is whatever the store's append()/scan_indexed() returned — a
+(segment_index, payload_offset) pair for SegmentStore, the payload bytes
+for MemoryRoundStore; this module never interprets it.
+
+Later records win, matching replay_records: a controller-failover standby
+can persist a round whose base regresses below an earlier record's end
+(re-covering rows whose producers were never acked), so add() drops any
+entries the new record's range supersedes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Iterable, Optional
+
+
+class LogIndex:
+    """Thread-safe (slot, offset) → append-record lookup."""
+
+    def __init__(self) -> None:
+        # slot -> parallel lists: bases (sorted ascending) and entries
+        self._bases: dict[int, list[int]] = {}
+        self._entries: dict[int, list[tuple[int, int, Any]]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, slot: int, base: int, nrows: int, locator: Any) -> None:
+        """Record one committed append round. Drops previously-indexed
+        entries with base >= the new base (later records win)."""
+        with self._lock:
+            bases = self._bases.setdefault(slot, [])
+            entries = self._entries.setdefault(slot, [])
+            while bases and bases[-1] >= base:
+                bases.pop()
+                entries.pop()
+            bases.append(base)
+            entries.append((base, nrows, locator))
+
+    def load(self, records: Iterable[tuple[int, int, int, bytes, Any]],
+             slot_bytes: int, rec_append: int) -> None:
+        """Boot-time build from a store's scan_indexed() stream."""
+        for rec_type, slot, base, payload, locator in records:
+            if rec_type != rec_append:
+                continue
+            self.add(slot, base, len(payload) // slot_bytes, locator)
+
+    def find(self, slot: int, offset: int) -> Optional[tuple[int, int, Any]]:
+        """The entry covering `offset`, or the next entry after it (a
+        consumer below the earliest retained record jumps forward — the
+        same semantics as Kafka's earliest reset), or None when nothing
+        at-or-after `offset` is indexed (the caller falls through to the
+        device ring)."""
+        with self._lock:
+            bases = self._bases.get(slot)
+            if not bases:
+                return None
+            entries = self._entries[slot]
+            i = bisect.bisect_right(bases, offset) - 1
+            if i >= 0:
+                base, nrows, locator = entries[i]
+                if offset < base + nrows:
+                    return entries[i]
+                i += 1
+            else:
+                i = 0
+            if i < len(entries):
+                return entries[i]
+            return None

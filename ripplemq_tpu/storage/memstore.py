@@ -25,10 +25,16 @@ class MemoryRoundStore:
         self._records: list[tuple[int, int, int, bytes]] = []
         self._lock = threading.Lock()
 
-    def append(self, rec_type: int, slot: int, base: int, payload: bytes) -> None:
+    def append(self, rec_type: int, slot: int, base: int,
+               payload: bytes) -> bytes:
+        """Append one record; the returned locator is the payload itself
+        (same append→locator contract as SegmentStore.append — the
+        retention read path is storage-agnostic)."""
+        payload = bytes(payload)
         with self._lock:
             self._records.append((int(rec_type), int(slot), int(base),
-                                  bytes(payload)))
+                                  payload))
+        return payload
 
     def flush(self) -> None:  # no durability tier to flush to
         pass
@@ -42,6 +48,17 @@ class MemoryRoundStore:
         with self._lock:
             snap = list(self._records)
         return iter(snap)
+
+    def scan_indexed(self) -> Iterator[
+        tuple[int, int, int, bytes, bytes]
+    ]:
+        """scan() plus each record's locator (the payload bytes)."""
+        for rec_type, slot, base, payload in self.scan():
+            yield rec_type, slot, base, payload, payload
+
+    def read_payload(self, locator: bytes, byte_start: int,
+                     nbytes: int) -> bytes:
+        return locator[byte_start : byte_start + nbytes]
 
     def __len__(self) -> int:
         with self._lock:

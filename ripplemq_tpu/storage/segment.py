@@ -64,6 +64,12 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_int,
         ]
+        lib.segstore_append_at.restype = ctypes.c_int
+        lib.segstore_append_at.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+        ]
         lib.segstore_flush.restype = ctypes.c_int
         lib.segstore_flush.argtypes = [ctypes.c_void_p]
         lib.segstore_close.restype = None
@@ -147,15 +153,22 @@ class SegmentStore:
     def is_native(self) -> bool:
         return self._handle is not None
 
-    def append(self, rec_type: int, slot: int, base: int, payload: bytes) -> None:
+    def append(self, rec_type: int, slot: int, base: int,
+               payload: bytes) -> tuple[int, int]:
+        """Append one framed record; returns its locator
+        (segment_index, payload_byte_offset) — the position the retention
+        read path (storage.logindex) serves lagging consumers from."""
         with self._lock:
             if self._handle is not None:
-                rc = self._lib.segstore_append(
-                    self._handle, rec_type, slot, base, payload, len(payload)
+                seg = ctypes.c_int()
+                off = ctypes.c_long()
+                rc = self._lib.segstore_append_at(
+                    self._handle, rec_type, slot, base, payload, len(payload),
+                    ctypes.byref(seg), ctypes.byref(off),
                 )
                 if rc != 0:
                     raise OSError("segstore_append failed")
-                return
+                return seg.value, off.value
             frame = _HEADER.pack(
                 _MAGIC, rec_type, slot, base, len(payload),
                 zlib.crc32(payload) & 0xFFFFFFFF,
@@ -167,8 +180,10 @@ class SegmentStore:
                 self._file.close()
                 self._seg_index += 1
                 self._file = open(self._seg_path(self._seg_index), "ab")
+            locator = (self._seg_index, self._file.tell() + _HEADER.size)
             self._file.write(frame)
             self._file.flush()
+            return locator
 
     def flush(self) -> None:
         """fsync the active segment (the durability barrier)."""
@@ -224,6 +239,32 @@ class SegmentStore:
         consistent prefix must order themselves against append (see
         broker/replication.py catch-up protocol)."""
         return scan_store(self.directory)
+
+    def scan_indexed(self) -> Iterator[tuple[int, int, int, bytes, tuple[int, int]]]:
+        """Like scan(), plus each record's locator (boot-time index build
+        for the retention read path). Python framing only — the native
+        scanner does not expose file positions and this runs once per
+        boot."""
+        for seg_idx, off, rec in _scan_python_indexed(self.directory):
+            rec_type, slot, base, payload = rec
+            yield rec_type, slot, base, payload, (seg_idx, off)
+
+    def read_payload(self, locator: tuple[int, int], byte_start: int,
+                     nbytes: int) -> bytes:
+        """Read `nbytes` of a record's payload starting `byte_start` bytes
+        in, by seek — no framing walk. The caller (storage.logindex) got
+        `locator` from append()/scan_indexed() and knows the payload
+        length; a short read means the store was truncated under us and
+        raises."""
+        seg_idx, off = locator
+        with open(self._seg_path(seg_idx), "rb") as f:
+            f.seek(off + byte_start)
+            data = f.read(nbytes)
+        if len(data) != nbytes:
+            raise OSError(
+                f"short payload read in segment {seg_idx} at {off}+{byte_start}"
+            )
+        return data
 
     def close(self) -> None:
         with self._lock:
@@ -294,12 +335,23 @@ def _scan_native(lib, directory: str):
 
 
 def _scan_python(directory: str):
+    for _seg, _off, rec in _scan_python_indexed(directory):
+        yield rec
+
+
+def _scan_python_indexed(directory: str):
+    """Python framing walk yielding (segment_index, payload_offset,
+    (type, slot, base, payload)) — same torn-tail/corruption contract as
+    scan_store."""
+    if not os.path.isdir(directory):
+        return
     files = sorted(
         f for f in os.listdir(directory)
         if f.startswith("segment-") and f.endswith(".log")
     )
     for fi, name in enumerate(files):
         last_file = fi + 1 == len(files)
+        seg_idx = int(name[8:16])
         with open(os.path.join(directory, name), "rb") as f:
             while True:
                 hdr = f.read(_HEADER.size)
@@ -314,9 +366,10 @@ def _scan_python(directory: str):
                     if last_file:
                         return
                     raise CorruptStoreError(f"bad magic in {name}")
+                payload_off = f.tell()
                 payload = f.read(length)
                 if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
                     if last_file:
                         return  # torn/corrupt tail record
                     raise CorruptStoreError(f"bad record in {name}")
-                yield rec_type, slot, base, payload
+                yield seg_idx, payload_off, (rec_type, slot, base, payload)

@@ -1,0 +1,231 @@
+"""Data-plane log retention: the device ring recycles trimmed rows, the
+round store serves history, and partitions never wedge.
+
+The reference grows partition state without bound in JVM heap
+(reference: mq-broker/src/main/java/metadata/raft/
+PartitionStateMachine.java:26-27) and never refuses an append; the ring
+design must match that capability over time: pushing many times `slots`
+entries through one partition keeps committing (no PartitionFullError),
+consumers that keep up read from the device ring across wrap boundaries,
+and lagging consumers replay the full history from the store.
+"""
+
+import numpy as np
+import pytest
+
+from ripplemq_tpu.broker.dataplane import (
+    DataPlane,
+    PartitionFullError,
+    recover_image,
+    replay_records,
+)
+from ripplemq_tpu.storage.memstore import MemoryRoundStore
+from ripplemq_tpu.storage.segment import SegmentStore
+from tests.helpers import small_cfg
+
+
+def drain_from(dp, slot, start, out):
+    """Advance a consumer from `start`, appending messages to `out`;
+    returns the next offset."""
+    offset = start
+    while True:
+        got, nxt = dp.read(slot, offset, replica=0)
+        if nxt == offset:
+            return offset
+        out.extend(got)
+        offset = nxt
+
+
+def test_three_laps_with_keeping_up_consumer():
+    """The VERDICT bar: 3 x slots entries through one partition with a
+    keeping-up consumer — every append commits, every message is read
+    exactly once, in order, across ring wraps."""
+    cfg = small_cfg(slots=64, max_batch=8)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        total = 3 * cfg.slots
+        sent, got = [], []
+        offset = 0
+        for i in range(total):
+            m = b"m%04d" % i
+            sent.append(m)
+            dp.submit_append(0, [m]).result(timeout=30)
+            if i % 5 == 4:  # consumer keeps up, reading as it goes
+                offset = drain_from(dp, 0, offset, got)
+        drain_from(dp, 0, offset, got)
+        assert got == sent
+        assert int(dp._log_end[0]) >= total  # wrapped the ring twice over
+        assert int(dp.trim[0]) > 0
+    finally:
+        dp.stop()
+
+
+def test_lagging_consumer_replays_history_from_store():
+    """A consumer starting at offset 0 after the ring wrapped reads the
+    FULL history — rows below the trim watermark come from the round
+    store via the log index, then reads hand back to the device ring."""
+    cfg = small_cfg(slots=64, max_batch=8)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        sent = []
+        for i in range(2 * cfg.slots + 24):
+            m = b"h%04d" % i
+            sent.append(m)
+            dp.submit_append(0, [m]).result(timeout=30)
+        assert int(dp.trim[0]) > 0  # history extends below the ring
+        got = []
+        drain_from(dp, 0, 0, got)
+        assert got == sent
+    finally:
+        dp.stop()
+
+
+def test_boundary_pad_round_when_batch_cannot_fit():
+    """A batch bigger than the rows left before the ring boundary rides a
+    boundary-padding round: the batch lands contiguously at the next lap
+    and nothing is lost or reordered."""
+    cfg = small_cfg(slots=32, max_batch=16)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        first = [b"a%02d" % i for i in range(8)]
+        dp.submit_append(0, first).result(timeout=30)          # end=8
+        second = [b"b%02d" % i for i in range(16)]
+        dp.submit_append(0, second).result(timeout=30)         # end=24
+        third = [b"c%02d" % i for i in range(16)]              # 8 rows left
+        off3 = dp.submit_append(0, third).result(timeout=30)
+        assert off3 == 32  # padded to the boundary, landed at lap start
+        got = []
+        drain_from(dp, 0, 0, got)
+        assert got == first + second + third
+    finally:
+        dp.stop()
+
+
+def test_device_read_window_spans_wrap_boundary():
+    """One read whose window crosses the ring end must blend rows from
+    the ring tail and the ring head correctly."""
+    cfg = small_cfg(slots=64, max_batch=8, read_batch=8)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        sent = []
+        for i in range(9):  # 72 rows: end=72, wraps 8 past the boundary
+            batch = [b"w%04d" % (8 * i + j) for j in range(8)]
+            sent.extend(batch)
+            dp.submit_append(0, batch).result(timeout=30)
+        # trim is 72+8-64 = 16; offset 60 >= trim is ring-served and its
+        # 8-row window [60, 68) crosses the boundary at 64.
+        got, nxt = dp.read(0, 60, replica=0)
+        assert got == sent[60:68]
+        assert nxt == 68
+    finally:
+        dp.stop()
+
+
+def test_recovery_after_wrap(tmp_path):
+    """Crash-recover a store whose partitions wrapped the ring: the
+    replayed image serves the ring-resident tail, the log index serves
+    the full history, and appends continue from the recovered end."""
+    cfg = small_cfg(slots=64, max_batch=8)
+    store_dir = str(tmp_path / "segments")
+    sent = []
+    store = SegmentStore(store_dir)
+    dp = DataPlane(cfg, mode="local", store=store, max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        for i in range(2 * cfg.slots + 16):
+            m = b"r%04d" % i
+            sent.append(m)
+            dp.submit_append(0, [m]).result(timeout=30)
+        end_before = int(dp._log_end[0])
+    finally:
+        dp.stop()
+        store.close()
+
+    image = recover_image(cfg, store_dir)
+    assert image is not None
+    assert int(image.log_end[0]) == end_before
+
+    store2 = SegmentStore(store_dir)
+    dp2 = DataPlane(cfg, mode="local", store=store2, max_retry_rounds=3)
+    dp2.install(image)
+    dp2.start()
+    try:
+        dp2.set_leader(0, 0, 1)
+        assert int(dp2.trim[0]) == end_before - cfg.slots
+        # Full-history replay (store-served below trim, ring above).
+        got = []
+        drain_from(dp2, 0, 0, got)
+        assert got == sent
+        # The log keeps going from the recovered absolute end.
+        off = dp2.submit_append(0, [b"post-recovery"]).result(timeout=30)
+        assert off == end_before
+        tail = []
+        drain_from(dp2, 0, end_before, tail)
+        assert tail == [b"post-recovery"]
+    finally:
+        dp2.stop()
+        store2.close()
+
+
+def test_storeless_dataplane_still_backpressures():
+    """Without a round store nothing can be trimmed: the bounded-log
+    behavior (PartitionFullError once no window fits) is preserved."""
+    cfg = small_cfg(slots=8, max_batch=8)
+    dp = DataPlane(cfg, mode="local", max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        assert dp.submit_append(0, [b"x"] * 8).result(timeout=10) == 0
+        with pytest.raises(PartitionFullError):
+            dp.submit_append(0, [b"y"]).result(timeout=10)
+    finally:
+        dp.stop()
+
+
+def test_spmd_ring_wrap_matches_local():
+    """Ring wrap + trim produce identical state under the vmap and
+    shard_map bindings (the SPMD equivalence contract extends to
+    retention)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
+    from ripplemq_tpu.parallel.mesh import make_mesh
+    from tests.helpers import make_input
+
+    cfg = small_cfg(partitions=4, replicas=2, slots=16, max_batch=8)
+    mesh = make_mesh(2, 2)
+    local, spmd = make_local_fns(cfg), make_spmd_fns(cfg, mesh)
+    ls, ss = local.init(), spmd.init()
+    alive = np.ones((2,), bool)
+    trim = np.zeros((4,), np.int32)
+    for lap in range(5):  # 40 rows through a 16-slot ring
+        inp = make_input(cfg, appends={0: [b"s%02d" % (8 * lap + j)
+                                           for j in range(8)]})
+        trim[0] = max(0, 8 * lap + 8 + 8 - 16)
+        ls, lout = local.step(ls, inp, alive, None, trim)
+        ss, sout = spmd.step(ss, inp, alive, None, trim)
+        assert bool(np.asarray(lout.committed)[0])
+        for a, b in zip(jax.tree.leaves(lout), jax.tree.leaves(sout)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ls), jax.tree.leaves(ss)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Both serve the ring-resident window identically.
+    ld = local.read(ls, 0, 0, 32)
+    sd = spmd.read(ss, 0, 0, 32)
+    for a, b in zip(jax.tree.leaves(ld), jax.tree.leaves(sd)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
