@@ -43,10 +43,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="device binding for the controller's engine: "
                          "'local' vmaps replicas on one chip, 'spmd' shards "
                          "a (replica x part) device mesh")
+    ap.add_argument("--log-level", default="INFO",
+                    help="console log level for the ripplemq loggers "
+                         "(DEBUG/INFO/WARNING/ERROR)")
     args = ap.parse_args(argv)
 
     from ripplemq_tpu.broker.server import BrokerServer
     from ripplemq_tpu.metadata.cluster_config import load_cluster_config
+    from ripplemq_tpu.utils.logs import configure_logging
+
+    configure_logging(args.log_level)
 
     try:
         config = load_cluster_config(args.config)

@@ -42,6 +42,9 @@ from ripplemq_tpu.storage.segment import (
     SegmentStore,
     scan_store,
 )
+from ripplemq_tpu.utils.logs import get_logger
+
+log = get_logger("dataplane")
 
 
 class NotCommittedError(Exception):
@@ -193,7 +196,7 @@ class DataPlane:
         # Guarded by self._lock (read by _drain, cleared by the resolver).
         self._busy_a: set[int] = set()   # partition slots with appends in flight
         self._busy_o: set[int] = set()   # ... with offset commits in flight
-        # Metrics (host-side counters; see utils.metrics for the registry).
+        # Host-side counters (exposed through the broker's admin.stats RPC).
         self.rounds = 0
         self.committed_entries = 0
         self.step_errors = 0
@@ -420,6 +423,13 @@ class DataPlane:
         (caller falls through to the ring)."""
         SB = self.cfg.slot_bytes
         entry = self.log_index.find(slot, offset)
+        floor = self.log_index.floor(slot)
+        if floor is not None and offset < floor:
+            # Below the bounded index's floor: records may exist in the
+            # store that fell out of the index — only a scan can tell.
+            scanned = self._scan_store_for(slot, offset)
+            if scanned is not None:
+                entry = scanned
         if entry is None:
             return None
         base, nrows, locator = entry
@@ -452,6 +462,31 @@ class DataPlane:
                     np.int32(consumer_slot),
                 )
             )
+
+    def _scan_store_for(
+        self, slot: int, offset: int
+    ) -> Optional[tuple[int, int, object]]:
+        """Slow path behind the bounded index: replay the store's append
+        records for one slot (honoring later-records-win truncation, as
+        replay_records does) and locate the covering-or-next entry. Full
+        framing walk of the store — only reachable for consumers lagging
+        by more than the index's per-slot entry cap."""
+        from ripplemq_tpu.storage.logindex import locate
+
+        SB = self.cfg.slot_bytes
+        bases: list[int] = []
+        entries: list[tuple[int, int, object]] = []
+        for rec_type, s, base, payload, locator in self.store.scan_indexed():
+            if rec_type != REC_APPEND or s != slot:
+                continue
+            while bases and bases[-1] >= base:
+                bases.pop()
+                entries.pop()
+            bases.append(base)
+            entries.append((base, len(payload) // SB, locator))
+        if not bases:
+            return None
+        return locate(bases, entries, offset)
 
     def commit_index(self, slot: int) -> int:
         """Max commit index across replicas (the leader's view)."""
@@ -515,6 +550,18 @@ class DataPlane:
                 if slot in self._busy_a:
                     continue  # one in-flight round per slot (ordering)
                 end = int(self._log_end[slot])
+                if end >= _OFFSET_HORIZON:
+                    # Authoritative horizon check (submit_append's check
+                    # races a deep backlog: it compares against a shadow
+                    # that only advances at resolve time). `end` here is
+                    # exact — the slot is not busy.
+                    for pend in queue:
+                        pend.future.set_exception(PartitionFullError(
+                            f"partition {slot} reached the int32 offset "
+                            f"horizon; re-key onto another partition"
+                        ))
+                    self._appends.pop(slot, None)
+                    continue
                 if can_trim:
                     # Lazy retention: raise the trim watermark just enough
                     # for a full window past the current end. Everything
@@ -635,6 +682,7 @@ class DataPlane:
                 # this round's futures and keep serving (one bad round must
                 # not wedge the whole data plane).
                 self.step_errors += 1
+                log.warning("step thread error: %s: %s", type(e).__name__, e)
                 if ctx is not None:
                     with self._lock:
                         self._busy_a -= ctx["appends"].keys()
@@ -677,6 +725,7 @@ class DataPlane:
             self._settle(ctx, base, committed)
         except Exception as e:
             self.step_errors += 1
+            log.warning("round resolve error: %s: %s", type(e).__name__, e)
             self._fail_round(ctx, e)
         finally:
             with self._lock:
@@ -732,6 +781,8 @@ class DataPlane:
             self.trim = np.maximum(0, ends - self.cfg.slots)
         with self._device_lock:
             self._state = self.fns.init_from(image)
+        log.info("installed recovered image: %d partitions with data, "
+                 "max log end %d", int((ends > 0).sum()), int(ends.max()))
 
     def _fail_round(self, ctx, exc: Exception) -> None:
         for taken in ctx["appends"].values():
@@ -784,6 +835,34 @@ class DataPlane:
                         )
                     else:
                         requeue_a.append((slot, pend))
+        # Failed boundary-pad rounds (empty taken) must still charge the
+        # blocked queue head's retry budget: the head is what forced the
+        # pad, and without this a quorum outage at the ring boundary would
+        # regenerate failing pads forever while the producer's future
+        # hangs past max_retry_rounds.
+        pad_failures = [
+            slot for slot, taken in ctx["appends"].items()
+            if not taken and not committed[slot]
+        ]
+        if pad_failures:
+            with self._lock:
+                for slot in pad_failures:
+                    queue = self._appends.get(slot)
+                    if not queue:
+                        continue
+                    head = queue[0]
+                    head.rounds_left -= 1
+                    if head.rounds_left <= 0:
+                        queue.pop(0)
+                        if not queue:
+                            self._appends.pop(slot, None)
+                        head.future.set_exception(
+                            NotCommittedError(
+                                f"partition {slot}: no quorum after "
+                                f"{self.max_retry_rounds} rounds (ring-"
+                                f"boundary pad)"
+                            )
+                        )
         for slot, taken_off in ctx["offsets"].items():
             if committed[slot]:
                 for pend in taken_off:

@@ -32,7 +32,10 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
+from ripplemq_tpu.utils.logs import get_logger
 from ripplemq_tpu.wire.transport import RpcError, Transport
+
+log = get_logger("hostraft")
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -200,6 +203,7 @@ class RaftNode:
         return len(self._votes) >= self.quorum
 
     def _become_leader(self) -> list[Outbound]:
+        log.info("node %d: metadata leader at term %d", self.id, self.term)
         self.role = LEADER
         self.leader_hint = self.id
         nxt = self.last_index() + 1
@@ -257,6 +261,9 @@ class RaftNode:
         return [(p, self._append_for(p)) for p in self.peers]
 
     def _step_down(self, term: int, leader: Optional[int] = None) -> None:
+        if self.role == LEADER:
+            log.info("node %d: stepping down at term %d (leader now %s)",
+                     self.id, term, leader)
         if term > self.term:
             self.term = term
             self.voted_for = None

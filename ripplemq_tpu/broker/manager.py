@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -89,6 +90,13 @@ class PartitionManager:
         self.controller_broker: int = config.controller
         self.controller_epoch: int = 0
         self.standbys: tuple[int, ...] = ()
+        # Election debounce: slot → when it was first seen leaderless.
+        # A partition must stay leaderless for config.election_timeout_s
+        # before the controller ballots it (the role JRaft's per-group
+        # election timeout plays in the reference,
+        # PartitionRaftServer.java:85); repeated failed ballots are
+        # likewise spaced by the timeout.
+        self._leaderless_since: dict[int, float] = {}
 
     # ------------------------------------------------- state machine hooks
 
@@ -476,6 +484,7 @@ class PartitionManager:
                 log_ends = self.dataplane.log_ends()      # [R, P]
             device_terms = self.dataplane.current_terms() # [P]
             live = set(self.live)
+            now = time.monotonic()
             cands: dict[int, tuple[int, int]] = {}
             drafts: dict[int, dict] = {}
             for t in self.topics:
@@ -484,7 +493,12 @@ class PartitionManager:
                     if slot is None:
                         continue
                     if a.leader is not None and a.leader in live:
+                        self._leaderless_since.pop(slot, None)
                         continue
+                    since = self._leaderless_since.setdefault(slot, now)
+                    if now - since < self.config.election_timeout_s:
+                        continue  # debounce (see __init__)
+                    self._leaderless_since[slot] = now  # space retries too
                     alive_replicas = [
                         (r, b)
                         for r, b in enumerate(a.replicas)

@@ -46,7 +46,10 @@ from concurrent.futures import Future
 from typing import Callable, Optional
 
 from ripplemq_tpu.broker.dataplane import NotCommittedError
+from ripplemq_tpu.utils.logs import get_logger
 from ripplemq_tpu.wire.transport import RpcError, Transport
+
+log = get_logger("replication")
 
 
 class FencedError(NotCommittedError):
@@ -283,6 +286,10 @@ class RoundReplicator:
                         and time.monotonic() - start > self.ack_timeout_s
                     ):
                         suspected = True
+                        log.warning(
+                            "standby %d not acking after %.1fs; flagged "
+                            "suspect", bid, self.ack_timeout_s,
+                        )
                         with self._lock:
                             self._suspects.add(bid)
                 except FencedError:

@@ -53,6 +53,7 @@ from ripplemq_tpu.broker.manager import (
 )
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import group_key, topics_to_wire
+from ripplemq_tpu.utils.logs import get_logger
 from ripplemq_tpu.wire.transport import (
     InProcNetwork,
     RpcError,
@@ -60,6 +61,8 @@ from ripplemq_tpu.wire.transport import (
     TcpServer,
     Transport,
 )
+
+log = get_logger("broker")
 
 
 class BrokerServer:
@@ -139,12 +142,18 @@ class BrokerServer:
             persist_fn = self._metastore.save
         else:
             self._metastore = None
+        # Metadata election timeout → hostraft tick counts (randomized in
+        # [1x, 2x], Raft-style; the reference's JRaft equivalent is
+        # NodeOptions.setElectionTimeoutMs, TopicsRaftServer.java:131).
+        etick = max(2, int(round(config.metadata_election_timeout_s
+                                 / tick_interval_s)))
         node = RaftNode(
             broker_id,
             config.broker_ids(),
             apply_fn=self.manager.apply,
             snapshot_fn=self.manager.snapshot,
             restore_fn=self.manager.restore,
+            election_ticks=(etick, 2 * etick),
             seed=broker_id * 7919,
             compact_threshold=256,
             persist_fn=persist_fn,
@@ -183,6 +192,10 @@ class BrokerServer:
             target=self._duty_loop, daemon=True, name=f"broker-duty-{broker_id}"
         )
         self.duty_errors: list[str] = []  # ring of recent duty failures
+        # Membership-poll cadence (reference: the 10 s membership monitor,
+        # TopicsRaftServer.java:216): assignment/controller planning runs
+        # at most every membership_poll_s, first pass immediate.
+        self._last_membership_poll = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -205,6 +218,11 @@ class BrokerServer:
         every replica slot."""
         from ripplemq_tpu.broker.dataplane import replay_records
 
+        log.info(
+            "broker %d: booting data plane as controller (epoch %d, "
+            "engine mode %s)",
+            self.broker_id, self.manager.current_epoch(), self._engine_mode,
+        )
         image = None
         if self._round_store is not None:
             image = replay_records(
@@ -306,6 +324,8 @@ class BrokerServer:
                 return self._handle_offset_commit(req)
             if t == "repl.rounds":
                 return self._handle_repl_rounds(req)
+            if t == "admin.stats":
+                return self._handle_stats(req)
             if t.startswith("engine."):
                 return self._handle_engine(t, req)
             return {"ok": False, "error": f"unknown request type {t!r}"}
@@ -313,6 +333,73 @@ class BrokerServer:
             return {"ok": False, "error": f"not_committed: {e}"}
         except (KeyError, ValueError, TypeError) as e:
             return {"ok": False, "error": f"bad_request: {type(e).__name__}: {e}"}
+
+    # -- observability -----------------------------------------------------
+
+    def _handle_stats(self, req: dict) -> dict:
+        """Broker stats/health snapshot: metadata role, controller state,
+        per-partition leadership, engine counters (controller only), and
+        the duty/erasure error rings. The reference's observability is a
+        log4j2 console stack (log4j2.xml:10-14); this adds the health
+        endpoint it lacked. `slots` (optional list) selects partitions
+        for per-slot engine detail (commit index, absolute end, trim)."""
+        node = self.runner.node
+        topics = {}
+        for t in self.manager.get_topics():
+            topics[t.name] = {
+                str(a.partition_id): {
+                    "leader": a.leader, "term": a.term,
+                    "replicas": list(a.replicas),
+                }
+                for a in t.assignments
+            }
+        stats = {
+            "ok": True,
+            "broker": self.broker_id,
+            "address": self.addr,
+            "metadata": {
+                "role": node.role,
+                "term": node.term,
+                "leader_hint": node.leader_hint,
+            },
+            "controller": {
+                "id": self.manager.current_controller(),
+                "epoch": self.manager.current_epoch(),
+                "standbys": list(self.manager.current_standbys()),
+                "is_self": self.is_controller,
+            },
+            "topics": topics,
+            "live": list(self.manager.live),
+            "duty_errors": list(self.duty_errors),
+            "erasure_errors": list(
+                getattr(self._round_store, "erasure_errors", [])
+            ),
+        }
+        dp = self._local_engine()
+        if dp is None:
+            stats["engine"] = None
+        else:
+            engine = {
+                "mode": self._engine_mode,
+                "rounds": dp.rounds,
+                "committed_entries": dp.committed_entries,
+                "step_errors": dp.step_errors,
+                "partitions": dp.cfg.partitions,
+            }
+            slots = req.get("slots")
+            if slots:
+                detail = {}
+                for s in slots:
+                    s = int(s)
+                    if 0 <= s < dp.cfg.partitions:
+                        detail[str(s)] = {
+                            "commit": dp.commit_index(s),
+                            "log_end": int(dp._log_end[s]),
+                            "trim": int(dp.trim[s]),
+                        }
+                engine["slots"] = detail
+            stats["engine"] = engine
+        return stats
 
     # -- metadata ----------------------------------------------------------
 
@@ -617,6 +704,8 @@ class BrokerServer:
                 self._controller_duty()
                 self._standby_duty()
             except Exception as e:  # duties must never kill the loop
+                log.warning("broker %d duty error: %s: %s",
+                            self.broker_id, type(e).__name__, e)
                 self.duty_errors.append(f"{type(e).__name__}: {e}")
                 del self.duty_errors[:-20]
 
@@ -624,6 +713,10 @@ class BrokerServer:
         node = self.runner.node
         if node.role != LEADER:
             return
+        now = time.monotonic()
+        if now - self._last_membership_poll < self.config.membership_poll_s:
+            return
+        self._last_membership_poll = now
         with self.runner.lock:
             alive = node.alive_peers(self._alive_horizon)
         if not alive:
@@ -645,6 +738,12 @@ class BrokerServer:
             return
         if self.manager.current_controller() == self.broker_id:
             return
+        log.info(
+            "broker %d: deposed as controller (epoch %d now at broker %s); "
+            "releasing the device program",
+            self.broker_id, self.manager.current_epoch(),
+            self.manager.current_controller(),
+        )
         dp = self.dataplane
         self.dataplane = None
         self.manager.detach_dataplane()
@@ -744,11 +843,18 @@ class BrokerServer:
                     if self.manager.current_epoch() != epoch:
                         return  # deposed mid-join; fence duty cleans up
                     time.sleep(0.02)
-            if not joined:
+            if joined:
+                log.info("broker %d: standby %d caught up and joined the "
+                         "standby set", self.broker_id, cand)
+            else:
+                log.warning("broker %d: catchup(%d) membership proposal "
+                            "failed; will retry", self.broker_id, cand)
                 self.duty_errors.append(f"catchup({cand}): membership "
                                         "proposal failed; will retry")
                 del self.duty_errors[:-20]
         except Exception as e:
+            log.warning("broker %d: catchup(%d) failed: %s: %s",
+                        self.broker_id, cand, type(e).__name__, e)
             self.duty_errors.append(
                 f"catchup({cand}): {type(e).__name__}: {e}"
             )

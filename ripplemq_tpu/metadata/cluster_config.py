@@ -29,8 +29,15 @@ class ClusterConfig:
     # one exists (election: PartitionRaftServer.java:85 / TopicsRaftServer
     # .java:131; membership poll: TopicsRaftServer.java:216; client
     # metadata refresh: ProducerClientImpl.java:18).
+    # How long a partition stays leaderless before the controller ballots
+    # it, and the spacing between failed ballots (PartitionManager.
+    # plan_elections debounce).
     election_timeout_s: float = 1.0
+    # Metadata (hostraft) election timeout: randomized in [1x, 2x] as the
+    # node's tick deadline; also sets the liveness horizon.
     metadata_election_timeout_s: float = 3.0
+    # Cadence of the metadata leader's assignment/controller planning
+    # (BrokerServer._metadata_leader_duty).
     membership_poll_s: float = 10.0
     metadata_refresh_s: float = 10.0
     rpc_timeout_s: float = 3.0

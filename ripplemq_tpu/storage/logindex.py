@@ -28,12 +28,20 @@ from typing import Any, Iterable, Optional
 
 
 class LogIndex:
-    """Thread-safe (slot, offset) → append-record lookup."""
+    """Thread-safe (slot, offset) → append-record lookup.
 
-    def __init__(self) -> None:
+    Memory is bounded: each slot keeps at most `max_entries_per_slot`
+    recent entries (one per committed round); older entries are dropped
+    and `floor(slot)` reports the lowest still-indexed base. Readers
+    below the floor fall back to a store scan (DataPlane._read_store) —
+    correct, just slow, and only reachable for consumers lagging by more
+    than max_entries_per_slot rounds."""
+
+    def __init__(self, max_entries_per_slot: int = 1024) -> None:
         # slot -> parallel lists: bases (sorted ascending) and entries
         self._bases: dict[int, list[int]] = {}
         self._entries: dict[int, list[tuple[int, int, Any]]] = {}
+        self._max = max(2, max_entries_per_slot)
         self._lock = threading.Lock()
 
     def add(self, slot: int, base: int, nrows: int, locator: Any) -> None:
@@ -47,6 +55,17 @@ class LogIndex:
                 entries.pop()
             bases.append(base)
             entries.append((base, nrows, locator))
+            if len(bases) > self._max:
+                del bases[: len(bases) - self._max]
+                del entries[: len(entries) - self._max]
+
+    def floor(self, slot: int) -> Optional[int]:
+        """Lowest indexed base for `slot` (None if nothing indexed).
+        Offsets below this may still exist in the store — only a store
+        scan can tell."""
+        with self._lock:
+            bases = self._bases.get(slot)
+            return bases[0] if bases else None
 
     def load(self, records: Iterable[tuple[int, int, int, bytes, Any]],
              slot_bytes: int, rec_append: int) -> None:
@@ -61,20 +80,28 @@ class LogIndex:
         consumer below the earliest retained record jumps forward — the
         same semantics as Kafka's earliest reset), or None when nothing
         at-or-after `offset` is indexed (the caller falls through to the
-        device ring)."""
+        device ring). Callers must check floor() first: an offset below
+        the floor would otherwise "jump" over records that exist in the
+        store but fell out of the bounded index."""
         with self._lock:
             bases = self._bases.get(slot)
             if not bases:
                 return None
-            entries = self._entries[slot]
-            i = bisect.bisect_right(bases, offset) - 1
-            if i >= 0:
-                base, nrows, locator = entries[i]
-                if offset < base + nrows:
-                    return entries[i]
-                i += 1
-            else:
-                i = 0
-            if i < len(entries):
-                return entries[i]
-            return None
+            return locate(bases, self._entries[slot], offset)
+
+
+def locate(bases: list[int], entries: list[tuple[int, int, Any]],
+           offset: int) -> Optional[tuple[int, int, Any]]:
+    """Covering-or-next lookup over parallel sorted (bases, entries)
+    lists — shared by the in-memory index and the store-scan slow path."""
+    i = bisect.bisect_right(bases, offset) - 1
+    if i >= 0:
+        base, nrows, _ = entries[i]
+        if offset < base + nrows:
+            return entries[i]
+        i += 1
+    else:
+        i = 0
+    if i < len(entries):
+        return entries[i]
+    return None

@@ -21,6 +21,10 @@ import threading
 import zlib
 from typing import Iterator, Optional
 
+from ripplemq_tpu.utils.logs import get_logger
+
+_log = get_logger("storage")
+
 REC_APPEND = 1
 REC_OFFSETS = 2
 REC_META = 3
@@ -222,6 +226,8 @@ class SegmentStore:
         try:
             protect_store(self.directory)
         except Exception as e:  # derived data: never take the store down
+            _log.warning("erasure encode failed for %s: %s: %s",
+                         self.directory, type(e).__name__, e)
             self.erasure_errors.append(f"{type(e).__name__}: {e}")
             del self.erasure_errors[:-20]
 

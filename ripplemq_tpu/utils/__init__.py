@@ -1,0 +1,5 @@
+"""Cross-cutting utilities (logging)."""
+
+from ripplemq_tpu.utils.logs import configure_logging, get_logger
+
+__all__ = ["configure_logging", "get_logger"]

@@ -1,0 +1,47 @@
+"""Logging: named loggers under one "ripplemq" root + console config.
+
+The reference ships a configured log4j2 console stack (reference:
+mq-broker/src/main/resources/log4j2.xml:10-14 — pattern
+"%d{HH:mm:ss.SSS} [%t] %-5level %logger{36} - %msg%n"); this is the
+equivalent: every subsystem logs through `get_logger(<subsystem>)`
+("ripplemq.broker", "ripplemq.dataplane", "ripplemq.hostraft",
+"ripplemq.replication", "ripplemq.storage"), and the process entry point
+calls `configure_logging()` once. Library code NEVER configures handlers
+itself (embedders own the root config), so imports stay side-effect
+free; unconfigured loggers follow stdlib defaults (warnings+ to stderr).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional, TextIO
+
+_ROOT = "ripplemq"
+
+# Mirrors the reference's log4j2 console pattern (thread, level, logger).
+_PATTERN = "%(asctime)s.%(msecs)03d [%(threadName)s] %(levelname)-5s %(name)s - %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+
+def get_logger(subsystem: str) -> logging.Logger:
+    """Logger for one subsystem, namespaced under the ripplemq root."""
+    return logging.getLogger(f"{_ROOT}.{subsystem}")
+
+
+def configure_logging(level: str | int = "INFO",
+                      stream: Optional[TextIO] = None) -> logging.Logger:
+    """Attach one console handler to the ripplemq root logger (idempotent:
+    reconfiguring replaces the previous handler, so tests and re-entrant
+    mains don't stack duplicates). Returns the root logger."""
+    root = logging.getLogger(_ROOT)
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    root.setLevel(level)
+    handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+    handler.setFormatter(logging.Formatter(_PATTERN, datefmt=_DATEFMT))
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    root.addHandler(handler)
+    root.propagate = False
+    return root

@@ -19,6 +19,12 @@ def make_config(n_brokers=3, topics=None, engine=None, **kw) -> ClusterConfig:
         partitions=sum(t.partitions for t in topics),
         replicas=max(t.replication_factor for t in topics),
     )
+    # Fast timings for tests; production defaults mirror the reference's
+    # constants (1 s elections, 10 s membership poll) and would slow every
+    # cluster test's bootstrap and failover paths by seconds.
+    kw.setdefault("election_timeout_s", 0.1)
+    kw.setdefault("metadata_election_timeout_s", 0.6)
+    kw.setdefault("membership_poll_s", 0.2)
     return ClusterConfig(
         brokers=tuple(
             BrokerInfo(i, "broker", 9000 + i) for i in range(n_brokers)

@@ -1,0 +1,206 @@
+"""Observability (admin.stats RPC + logging) and the timing knobs.
+
+The reference's observability is a configured log4j2 console stack
+(reference: mq-broker/src/main/resources/log4j2.xml:10-14) and nothing
+else; this framework adds a stats/health RPC on every broker. The timing
+knobs (election_timeout_s, metadata_election_timeout_s,
+membership_poll_s) must all be LIVE — changing them changes behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import pytest
+
+from ripplemq_tpu.broker.dataplane import DataPlane
+from ripplemq_tpu.broker.manager import PartitionManager
+from ripplemq_tpu.broker.server import BrokerServer
+from ripplemq_tpu.wire.transport import InProcNetwork
+from tests.broker_harness import InProcCluster, make_config
+
+
+def wait_until(pred, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------- admin.stats
+
+def test_admin_stats_surface():
+    """Every broker answers admin.stats; the controller reports engine
+    counters and per-slot detail, frontends report engine=None; both see
+    the same controller/topics picture."""
+    with InProcCluster(make_config(3)) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl = next(b for b in c.brokers.values() if b.is_controller)
+        front = next(b for b in c.brokers.values() if not b.is_controller)
+
+        # Traffic so the counters are nonzero.
+        resp = client.call(
+            ctrl.addr,
+            {"type": "produce", "topic": "topic1", "partition": 0,
+             "messages": [b"s1", b"s2"]},
+            timeout=10.0,
+        )
+        if not resp.get("ok"):  # leader may be a frontend; follow the hint
+            resp = client.call(
+                resp["leader_addr"],
+                {"type": "produce", "topic": "topic1", "partition": 0,
+                 "messages": [b"s1", b"s2"]},
+                timeout=10.0,
+            )
+        assert resp["ok"], resp
+
+        stats = client.call(ctrl.addr, {"type": "admin.stats", "slots": [0]},
+                            timeout=5.0)
+        assert stats["ok"]
+        assert stats["controller"]["is_self"]
+        assert stats["engine"]["rounds"] >= 1
+        assert stats["engine"]["committed_entries"] >= 2
+        assert stats["engine"]["slots"]["0"]["commit"] >= 2
+        assert stats["engine"]["slots"]["0"]["log_end"] >= 2
+        # All partitions have elected leaders, visible in the stats.
+        for t in stats["topics"].values():
+            for a in t.values():
+                assert a["leader"] is not None and a["term"] >= 1
+
+        fstats = client.call(front.addr, {"type": "admin.stats"}, timeout=5.0)
+        assert fstats["ok"]
+        assert fstats["engine"] is None
+        assert fstats["controller"]["id"] == stats["controller"]["id"]
+
+
+def test_admin_stats_shows_new_leader_after_broker_death():
+    """VERDICT next-#6 'done' bar: a failover's new-leader election is
+    visible through admin.stats (leader moved, term bumped)."""
+    with InProcCluster(make_config(4)) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        any_b = next(iter(c.brokers.values()))
+        ctrl_id = any_b.manager.current_controller()
+        meta_leader = next(
+            i for i, b in c.brokers.items() if b.runner.node.role == "leader"
+        )
+        before = client.call(any_b.addr, {"type": "admin.stats"}, timeout=5.0)
+        candidates = [
+            (tname, int(p), a["leader"], a["term"])
+            for tname, t in before["topics"].items()
+            for p, a in t.items()
+            if a["leader"] not in (None, ctrl_id)
+        ]
+        # Prefer a victim that is not also the metadata leader (kills one
+        # role at a time; double-role death is covered by the controller
+        # failover suite).
+        candidates.sort(key=lambda x: x[2] == meta_leader)
+        assert candidates, before["topics"]
+        tname, pid, victim, old_term = candidates[0]
+        c.net.set_down(c.brokers[victim].addr)
+        c.brokers[victim].stop()
+        survivor = next(b for i, b in c.brokers.items() if i != victim)
+
+        def healed():
+            s = client.call(survivor.addr, {"type": "admin.stats"},
+                            timeout=5.0)
+            a = s["topics"][tname][str(pid)]
+            return a["leader"] not in (None, victim) and a["term"] > old_term
+
+        assert wait_until(healed, timeout=60), client.call(
+            survivor.addr, {"type": "admin.stats"}, timeout=5.0
+        )["topics"]
+
+
+# -------------------------------------------------------------------- logging
+
+def test_leader_election_and_duty_errors_are_logged(caplog):
+    caplog.set_level(logging.INFO, logger="ripplemq")
+    with InProcCluster(make_config(3)) as c:
+        c.wait_for_leaders()
+        # Metadata leadership logged by hostraft.
+        assert any(
+            "metadata leader at term" in r.message
+            for r in caplog.records if r.name == "ripplemq.hostraft"
+        )
+        # Duty failures are logged (not just ring-buffered): break one
+        # broker's duty and watch the warning.
+        b = next(iter(c.brokers.values()))
+
+        def boom():
+            raise RuntimeError("duty-test-explosion")
+
+        b._standby_duty = boom
+        assert wait_until(
+            lambda: any(
+                "duty-test-explosion" in r.message
+                for r in caplog.records if r.name == "ripplemq.broker"
+            ),
+            timeout=10,
+        )
+        assert any("duty-test-explosion" in e for e in b.duty_errors)
+
+
+# ---------------------------------------------------------------------- knobs
+
+def test_election_timeout_debounces_dataplane_elections():
+    """election_timeout_s gates how long a partition must stay leaderless
+    before the controller ballots it — and 0 disables the debounce."""
+    def planner(timeout_s):
+        config = make_config(3, election_timeout_s=timeout_s)
+        m = PartitionManager(0, config)
+        dp = DataPlane(config.engine, mode="local")
+        m.attach_dataplane(dp)
+        cmd = m.plan_assignment([0, 1, 2])
+        assert cmd is not None
+        m.apply(1, cmd)
+        return m
+
+    slow = planner(30.0)
+    cands, _ = slow.plan_elections()
+    assert not cands  # freshly leaderless: debounced
+
+    fast = planner(0.0)
+    cands, drafts = fast.plan_elections()
+    assert cands and drafts  # no debounce: ballots immediately
+
+    # And the debounce expires: a short timeout elects after the wait.
+    short = planner(0.15)
+    assert not short.plan_elections()[0]
+    time.sleep(0.2)
+    assert short.plan_elections()[0]
+
+
+def test_metadata_election_timeout_sets_hostraft_ticks():
+    """metadata_election_timeout_s drives the hostraft election deadline
+    (randomized in [1x, 2x] of the timeout, in ticks)."""
+    net = InProcNetwork()
+    config = make_config(3, metadata_election_timeout_s=1.0)
+    s = BrokerServer(0, config, net=net, tick_interval_s=0.05)
+    assert s.runner.node._election_ticks == (20, 40)
+    config2 = make_config(3, metadata_election_timeout_s=0.5)
+    s2 = BrokerServer(1, config2, net=net, tick_interval_s=0.05)
+    assert s2.runner.node._election_ticks == (10, 20)
+
+
+def test_membership_poll_gates_liveness_reaction():
+    """membership_poll_s is the metadata leader's planning cadence: with a
+    long poll, a broker death is NOT acted on between polls (the default
+    test config's 0.2 s poll heals in well under a second —
+    tests/test_failover.py)."""
+    config = make_config(3, membership_poll_s=30.0)
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()  # bootstrap assignment = the first poll
+        victim = next(
+            i for i, b in c.brokers.items()
+            if b.runner.node.role != "leader" and not b.is_controller
+        )
+        c.net.set_down(c.brokers[victim].addr)
+        c.brokers[victim].stop()
+        time.sleep(1.5)  # >> liveness horizon (0.6 s), << poll period
+        survivor = next(b for i, b in c.brokers.items() if i != victim)
+        assert victim in survivor.manager.live  # not re-planned yet

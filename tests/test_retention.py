@@ -180,6 +180,59 @@ def test_recovery_after_wrap(tmp_path):
         store2.close()
 
 
+def test_bounded_index_falls_back_to_store_scan():
+    """The log index caps per-slot entries; consumers lagging below its
+    floor are served through the store-scan slow path, still losslessly
+    and in order."""
+    from ripplemq_tpu.storage.logindex import LogIndex
+
+    cfg = small_cfg(slots=64, max_batch=8)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.log_index = LogIndex(max_entries_per_slot=4)  # force the floor low
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        sent = []
+        for i in range(2 * cfg.slots):
+            m = b"f%04d" % i
+            sent.append(m)
+            dp.submit_append(0, [m]).result(timeout=30)
+        assert dp.log_index.floor(0) > 0  # entries fell out of the index
+        assert int(dp.trim[0]) > dp.log_index.floor(0) - cfg.slots
+        got = []
+        drain_from(dp, 0, 0, got)
+        assert got == sent
+    finally:
+        dp.stop()
+
+
+def test_pad_round_quorum_outage_fails_cleanly():
+    """A batch blocked behind the ring boundary during a quorum outage
+    must fail with NotCommittedError after max_retry_rounds — the
+    boundary-padding rounds it forces charge its retry budget (they carry
+    no futures of their own)."""
+    from ripplemq_tpu.broker.dataplane import NotCommittedError
+
+    cfg = small_cfg(slots=32, max_batch=16, replicas=3)
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(),
+                   max_retry_rounds=3)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        dp.submit_append(0, [b"x"] * 8).result(timeout=30)  # end=8: 24 left
+        # Kill quorum, then submit a 16-row batch that needs a pad round
+        # once the ring boundary is 8 rows away... push to end=24 first.
+        dp.submit_append(0, [b"y"] * 16).result(timeout=30)  # end=24
+        alive = np.ones((cfg.partitions, cfg.replicas), bool)
+        alive[:, 1:] = False  # only the leader left: no quorum
+        dp.set_alive(alive)
+        with pytest.raises(NotCommittedError):
+            dp.submit_append(0, [b"z"] * 16).result(timeout=30)
+    finally:
+        dp.stop()
+
+
 def test_storeless_dataplane_still_backpressures():
     """Without a round store nothing can be trimmed: the bounded-log
     behavior (PartitionFullError once no window fits) is preserved."""
