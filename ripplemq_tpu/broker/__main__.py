@@ -46,6 +46,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--log-level", default="INFO",
                     help="console log level for the ripplemq loggers "
                          "(DEBUG/INFO/WARNING/ERROR)")
+    ap.add_argument("--coordinator", default=None,
+                    help="multi-host SPMD: host 0's host:port for "
+                         "jax.distributed (run the controller with "
+                         "--engine-mode spmd on every participating "
+                         "host; see parallel.multihost_check)")
+    ap.add_argument("--num-hosts", type=int, default=1,
+                    help="multi-host SPMD: number of participating hosts")
+    ap.add_argument("--host-index", type=int, default=0,
+                    help="multi-host SPMD: this process's index")
+    ap.add_argument("--engine-workers", default=None,
+                    help="multi-host SPMD: comma-separated host:port of "
+                         "the engine workers on the other hosts (run "
+                         "python -m ripplemq_tpu.parallel.worker there); "
+                         "required with --coordinator so every process "
+                         "launches each mesh computation")
     args = ap.parse_args(argv)
 
     from ripplemq_tpu.broker.server import BrokerServer
@@ -53,6 +68,18 @@ def main(argv: list[str] | None = None) -> int:
     from ripplemq_tpu.utils.logs import configure_logging
 
     configure_logging(args.log_level)
+
+    if args.coordinator is not None:
+        # Join the global mesh BEFORE any other JAX use: after this,
+        # jax.devices() is the global device list and the controller's
+        # spmd engine spans every host (collectives ride ICI within a
+        # host, DCN across).
+        from ripplemq_tpu.parallel.mesh import init_distributed
+
+        n = init_distributed(args.coordinator, args.num_hosts,
+                             args.host_index)
+        print(f"joined {args.num_hosts}-host mesh: {n} global devices",
+              flush=True)
 
     try:
         config = load_cluster_config(args.config)
@@ -66,11 +93,30 @@ def main(argv: list[str] | None = None) -> int:
         data_dir = os.path.join(args.data_dir, f"broker-{args.broker_id}")
         os.makedirs(data_dir, exist_ok=True)
 
+    workers = None
+    if args.engine_workers:
+        workers = [w.strip() for w in args.engine_workers.split(",") if w.strip()]
+    if args.coordinator is not None and args.num_hosts > 1:
+        if not workers:
+            print("error: --coordinator with --num-hosts > 1 requires "
+                  "--engine-workers (every process of a jax.distributed "
+                  "mesh must launch each computation; run "
+                  "python -m ripplemq_tpu.parallel.worker on the other "
+                  "hosts)", file=sys.stderr)
+            return 2
+        if args.engine_mode != "spmd":
+            print("error: --coordinator with --num-hosts > 1 requires "
+                  "--engine-mode spmd (mode 'local' would silently serve "
+                  "from this host's devices alone while the workers wait "
+                  "forever)", file=sys.stderr)
+            return 2
+
     server = BrokerServer(
         args.broker_id, config,
         net=None,  # real TCP sockets
         engine_mode=args.engine_mode,
         data_dir=data_dir,
+        engine_workers=workers,
     )
 
     stop = threading.Event()

@@ -61,6 +61,22 @@ class PartitionFullError(NotCommittedError):
     served from the store via the log index."""
 
 
+def _fetch_global(x) -> np.ndarray:
+    """np.asarray that also works for arrays sharded across PROCESSES
+    (multi-host spmd mode): a device-local shard set can't materialize
+    the full value, so gather it through the coordination service. Step/
+    vote/read outputs never need this — the engine replicates them onto
+    every device (parallel.engine._gather_part); only raw state fetches
+    (log ends, terms, commit) do."""
+    if getattr(x, "is_fully_addressable", True) or getattr(
+        x, "is_fully_replicated", False
+    ):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 # Device offsets (log_end/commit/trim) are int32 — the TPU-native scalar
 # width (int64 is emulated). A partition appending past 2^31 rows would
 # wrap negative and silently corrupt capacity/commit/read arithmetic, so
@@ -96,13 +112,15 @@ class DataPlane:
         cfg: EngineConfig,
         mode: str = "local",
         mesh=None,
-        part_shards: int = 1,
+        part_shards: Optional[int] = None,
         max_retry_rounds: int = 8,
         store: Optional[SegmentStore] = None,
         flush_interval_s: float = 0.05,
         pipeline_depth: int = 8,
         coalesce_s: float = 0.002,
         replicate_fn=None,
+        workers: Optional[list[str]] = None,
+        worker_client=None,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -125,6 +143,7 @@ class DataPlane:
         self.trim = np.zeros((P0,), np.int64)
         self._log_end = np.zeros((P0,), np.int64)
         self.log_index = None
+        self._scan_index = None  # lazy full-history index (_scan_store_for)
         if store is not None and hasattr(store, "scan_indexed"):
             from ripplemq_tpu.storage.logindex import LogIndex
 
@@ -143,8 +162,30 @@ class DataPlane:
         if mode == "local":
             self.fns = make_local_fns(cfg)
         elif mode == "spmd":
-            mesh = mesh if mesh is not None else make_mesh(cfg.replicas, part_shards)
+            if mesh is None:
+                if part_shards is None:
+                    # Auto: use every device (local chips, or the GLOBAL
+                    # device list under jax.distributed).
+                    import jax
+
+                    part_shards = max(1, len(jax.devices()) // cfg.replicas)
+                    while cfg.partitions % part_shards:
+                        part_shards -= 1  # partitions must tile evenly
+                mesh = make_mesh(cfg.replicas, part_shards)
+            else:
+                part_shards = mesh.shape["part"]
             self.fns = make_spmd_fns(cfg, mesh)
+            if workers:
+                # Multi-host: broadcast every engine call to the engine
+                # workers on the other hosts (parallel.lockstep) so the
+                # whole mesh launches each computation.
+                from ripplemq_tpu.parallel.lockstep import LockstepController
+                from ripplemq_tpu.wire.transport import TcpClient
+
+                self.fns = LockstepController(
+                    self.fns, cfg, part_shards, workers,
+                    worker_client if worker_client is not None else TcpClient(),
+                )
         else:
             raise ValueError(f"unknown mode {mode!r}")
         self.max_retry_rounds = max_retry_rounds
@@ -252,18 +293,27 @@ class DataPlane:
         with self._lock:
             self.quorum = quorum.copy()
 
+    def _fetch_state(self, field: str) -> np.ndarray:
+        """Host copy of one state leaf. Under lockstep, the allgather is
+        a broadcast engine call (every process must launch it); callers
+        must hold _device_lock."""
+        fetch = getattr(self.fns, "fetch_state", None)
+        if fetch is not None:
+            return fetch(self._state, field)
+        return _fetch_global(getattr(self._state, field))
+
     def log_ends(self) -> np.ndarray:
         """Per-replica log ends [R, P] — the lag map the repair loop uses
         to find replicas needing resync."""
         with self._device_lock:
-            return np.asarray(self._state.log_end)
+            return self._fetch_state("log_end")
 
     def current_terms(self) -> np.ndarray:
         """Max observed term per partition [P] (election planners must
         propose above this, or granted-then-unadvertised elections would
         deadlock retries)."""
         with self._device_lock:
-            return np.asarray(self._state.current_term).max(axis=0)
+            return self._fetch_state("current_term").max(axis=0)
 
     # ------------------------------------------------------------- submits
 
@@ -466,32 +516,42 @@ class DataPlane:
     def _scan_store_for(
         self, slot: int, offset: int
     ) -> Optional[tuple[int, int, object]]:
-        """Slow path behind the bounded index: replay the store's append
-        records for one slot (honoring later-records-win truncation, as
-        replay_records does) and locate the covering-or-next entry. Full
-        framing walk of the store — only reachable for consumers lagging
-        by more than the index's per-slot entry cap."""
-        from ripplemq_tpu.storage.logindex import locate
+        """Slow path behind the bounded index: one full framing walk of
+        the store builds an UNBOUNDED throwaway LogIndex (same add()
+        truncation semantics as the live one), cached until the next
+        install(). Records below the live index's floor are immutable
+        (later-records-win regressions only touch unsettled tail rounds),
+        so serving a whole catch-up from one scan is sound; entries the
+        cache lacks (appended after the scan) live above the floor and
+        are served by the live index. Only reachable for consumers
+        lagging by more than the index's per-slot entry cap."""
+        def build():
+            import sys as _sys
 
-        SB = self.cfg.slot_bytes
-        bases: list[int] = []
-        entries: list[tuple[int, int, object]] = []
-        for rec_type, s, base, payload, locator in self.store.scan_indexed():
-            if rec_type != REC_APPEND or s != slot:
-                continue
-            while bases and bases[-1] >= base:
-                bases.pop()
-                entries.pop()
-            bases.append(base)
-            entries.append((base, len(payload) // SB, locator))
-        if not bases:
-            return None
-        return locate(bases, entries, offset)
+            from ripplemq_tpu.storage.logindex import LogIndex
+
+            idx = LogIndex(max_entries_per_slot=_sys.maxsize)
+            idx.load(self.store.scan_indexed(), self.cfg.slot_bytes,
+                     REC_APPEND)
+            return idx
+
+        if self._scan_index is None:
+            self._scan_index = build()
+        entry = self._scan_index.find(slot, offset)
+        if entry is None or not entry[0] <= offset < entry[0] + entry[1]:
+            # The cached scan predates records that have since fallen out
+            # of the bounded live index (its floor rose past them) — a
+            # non-covering answer here could silently jump a consumer
+            # over store-resident data. Rebuild once from the current
+            # store before trusting it.
+            self._scan_index = build()
+            entry = self._scan_index.find(slot, offset)
+        return entry
 
     def commit_index(self, slot: int) -> int:
         """Max commit index across replicas (the leader's view)."""
         with self._device_lock:
-            commit = np.asarray(self._state.commit)  # [R, P]
+            commit = self._fetch_state("commit")  # [R, P]
         return int(commit[:, slot].max())
 
     # ----------------------------------------------------------- elections
@@ -556,10 +616,12 @@ class DataPlane:
                     # that only advances at resolve time). `end` here is
                     # exact — the slot is not busy.
                     for pend in queue:
-                        pend.future.set_exception(PartitionFullError(
-                            f"partition {slot} reached the int32 offset "
-                            f"horizon; re-key onto another partition"
-                        ))
+                        if not pend.future.done():  # caller may cancel()
+                            pend.future.set_exception(PartitionFullError(
+                                f"partition {slot} reached the int32 "
+                                f"offset horizon; re-key onto another "
+                                f"partition"
+                            ))
                     self._appends.pop(slot, None)
                     continue
                 if can_trim:
@@ -779,6 +841,7 @@ class DataPlane:
         with self._lock:
             self._log_end = ends.copy()
             self.trim = np.maximum(0, ends - self.cfg.slots)
+            self._scan_index = None  # history may differ on this store
         with self._device_lock:
             self._state = self.fns.init_from(image)
         log.info("installed recovered image: %d partitions with data, "
@@ -820,19 +883,21 @@ class DataPlane:
                 for pend, _, _ in taken:
                     pend.rounds_left -= 1
                     if full:
-                        pend.future.set_exception(
-                            PartitionFullError(
-                                f"partition {slot}: log full "
-                                f"({base[slot]}/{self.cfg.slots} used)"
+                        if not pend.future.done():  # caller may cancel()
+                            pend.future.set_exception(
+                                PartitionFullError(
+                                    f"partition {slot}: log full "
+                                    f"({base[slot]}/{self.cfg.slots} used)"
+                                )
                             )
-                        )
                     elif pend.rounds_left <= 0:
-                        pend.future.set_exception(
-                            NotCommittedError(
-                                f"partition {slot}: no quorum after "
-                                f"{self.max_retry_rounds} rounds"
+                        if not pend.future.done():
+                            pend.future.set_exception(
+                                NotCommittedError(
+                                    f"partition {slot}: no quorum after "
+                                    f"{self.max_retry_rounds} rounds"
+                                )
                             )
-                        )
                     else:
                         requeue_a.append((slot, pend))
         # Failed boundary-pad rounds (empty taken) must still charge the
@@ -856,13 +921,14 @@ class DataPlane:
                         queue.pop(0)
                         if not queue:
                             self._appends.pop(slot, None)
-                        head.future.set_exception(
-                            NotCommittedError(
-                                f"partition {slot}: no quorum after "
-                                f"{self.max_retry_rounds} rounds (ring-"
-                                f"boundary pad)"
+                        if not head.future.done():  # caller may cancel()
+                            head.future.set_exception(
+                                NotCommittedError(
+                                    f"partition {slot}: no quorum after "
+                                    f"{self.max_retry_rounds} rounds (ring-"
+                                    f"boundary pad)"
+                                )
                             )
-                        )
         for slot, taken_off in ctx["offsets"].items():
             if committed[slot]:
                 for pend in taken_off:
@@ -872,9 +938,12 @@ class DataPlane:
                 for pend in taken_off:
                     pend.rounds_left -= 1
                     if pend.rounds_left <= 0:
-                        pend.future.set_exception(
-                            NotCommittedError(f"partition {slot}: no quorum")
-                        )
+                        if not pend.future.done():  # caller may cancel()
+                            pend.future.set_exception(
+                                NotCommittedError(
+                                    f"partition {slot}: no quorum"
+                                )
+                            )
                     else:
                         requeue_o.append((slot, pend))
         if requeue_a or requeue_o:

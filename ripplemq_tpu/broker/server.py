@@ -79,12 +79,17 @@ class BrokerServer:
         tick_interval_s: float = 0.05,
         duty_interval_s: float = 0.1,
         data_dir: Optional[str] = None,
+        engine_workers: Optional[list[str]] = None,
     ) -> None:
         self.broker_id = broker_id
         self.config = config
         self.info = config.broker(broker_id)
         self._net = net
         self._engine_mode = engine_mode
+        # Multi-host spmd: engine-worker endpoints on the OTHER hosts of
+        # the jax.distributed mesh (parallel.worker); the controller's
+        # DataPlane broadcasts its engine-call stream to them.
+        self._engine_workers = list(engine_workers or [])
         self._duty_interval_s = duty_interval_s
         self._stop = threading.Event()
         self._started = False
@@ -229,7 +234,9 @@ class BrokerServer:
                 self.config.engine, self._round_store.scan()
             )
         dp = DataPlane(
-            self.config.engine, mode=self._engine_mode, store=self._round_store
+            self.config.engine, mode=self._engine_mode,
+            store=self._round_store,
+            workers=self._engine_workers or None,
         )
         if image is not None:
             dp.install(image)
@@ -388,12 +395,16 @@ class BrokerServer:
             }
             slots = req.get("slots")
             if slots:
+                # One device fetch for ALL requested slots (a per-slot
+                # commit_index() loop would sync the device — and stall
+                # the round pipeline — once per slot).
+                commits = dp.log_ends().max(axis=0)  # committed == end
                 detail = {}
                 for s in slots:
                     s = int(s)
                     if 0 <= s < dp.cfg.partitions:
                         detail[str(s)] = {
-                            "commit": dp.commit_index(s),
+                            "commit": int(commits[s]),
                             "log_end": int(dp._log_end[s]),
                             "trim": int(dp.trim[s]),
                         }

@@ -229,6 +229,27 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
 
     default_trim = jnp.zeros((cfg.partitions,), jnp.int32)
 
+    def _gather_part(tree):
+        """Replicate per-shard [P_local] outputs to full [P] on every
+        device. Outputs are tiny int32/bool vectors, and full replication
+        lets the host fetch them with a plain np.asarray even when the
+        mesh spans processes (multi-host: every process holds an
+        addressable copy). Built as a masked psum — the same pattern as
+        the read path — so shard_map's replication checker knows the
+        result is invariant over "part"."""
+        idx = jax.lax.axis_index("part")
+
+        def g(x):
+            v = x.astype(jnp.int32) if x.dtype == jnp.bool_ else x
+            full = jnp.zeros((part_shards,) + v.shape, v.dtype)
+            full = jax.lax.dynamic_update_index_in_dim(full, v, idx, 0)
+            out = jax.lax.psum(full, "part").reshape(
+                (part_shards * v.shape[0],) + v.shape[1:]
+            )
+            return out.astype(jnp.bool_) if x.dtype == jnp.bool_ else out
+
+        return jax.tree.map(g, tree)
+
     # ---- step -------------------------------------------------------------
     def step_body(state, inp, rep, alive, quorum, trim):
         st = _squeeze(state)          # strip the size-1 replica block dim
@@ -241,14 +262,15 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
             ctl.do_write[None]
         )
         new_st = new_st._replace(log_data=log_data[0])
-        return _expand(new_st), ctl.out  # out is psum-replicated over "replica"
+        # out is psum-replicated over "replica"; gather it over "part".
+        return _expand(new_st), _gather_part(ctl.out)
 
     smapped_step = _shard_map(
         step_body,
         mesh=mesh,
         in_specs=(st_specs, in_specs, P("replica"), P("part", None), P("part"),
                   P("part")),
-        out_specs=(st_specs, StepOutput(P("part"), P("part"), P("part"), P("part"))),
+        out_specs=(st_specs, StepOutput(P(), P(), P(), P())),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -267,6 +289,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         new_st, elected, votes = core_step.vote_step(
             cfg, st, cand, cand_term, rep[0], alive, quorum
         )
+        elected, votes = _gather_part((elected, votes))
         return _expand(new_st), elected, votes
 
     smapped_vote = _shard_map(
@@ -274,7 +297,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         mesh=mesh,
         in_specs=(st_specs, P("part"), P("part"), P("replica"),
                   P("part", None), P("part")),
-        out_specs=(st_specs, P("part"), P("part")),
+        out_specs=(st_specs, P(), P()),
     )
 
     @functools.partial(jax.jit, donate_argnums=(0,))

@@ -22,6 +22,30 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def init_distributed(
+    coordinator: str,
+    num_hosts: int,
+    host_index: int,
+) -> int:
+    """Join this process to a multi-host mesh via jax.distributed.
+
+    `coordinator` is host 0's "host:port"; every participating process
+    calls this ONCE before any other JAX use, after which jax.devices()
+    returns the GLOBAL device list and make_mesh() builds meshes whose
+    collectives ride ICI within a host and DCN across hosts — the scale
+    path the reference reaches with one JRaft/Bolt JVM per machine
+    (reference: mq-broker/src/main/java/metadata/raft/
+    PartitionRaftServer.java:83-93 peers across hosts). Returns the
+    global device count.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_index,
+    )
+    return len(jax.devices())
+
+
 def pick_axes(n_devices: int, replicas: int | None = None) -> tuple[int, int]:
     """Choose (replica, part) axis sizes for n devices.
 
