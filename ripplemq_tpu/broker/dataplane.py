@@ -121,6 +121,7 @@ class DataPlane:
         replicate_fn=None,
         workers: Optional[list[str]] = None,
         worker_client=None,
+        resolver_threads: int = 4,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -207,21 +208,25 @@ class DataPlane:
             target=self._run, daemon=True, name="dataplane-step"
         )
         # Two-stage round pipeline: the STEP thread only drains queues and
-        # dispatches device rounds; the RESOLVER thread blocks on each
-        # round's (base, committed) host fetch, persists it, and settles
-        # its futures — in dispatch order. The device executes rounds in
-        # dispatch order, so this changes nothing semantically; it keeps
-        # the dispatch path free of host↔device sync latency (which
-        # dominates when the chip sits behind a network tunnel: ~100 ms
-        # RTT vs ~3 ms of compute — new arrivals must not wait behind a
-        # blocking fetch). The bounded queue backpressures dispatch at
-        # `pipeline_depth` outstanding rounds. Per-slot serialization
-        # (busy sets) keeps at most ONE in-flight round per partition, so
-        # a failed round's retries can never be reordered behind later
-        # submits for the same partition.
+        # dispatches device rounds; RESOLVER threads block on each
+        # round's `committed` host fetch, persist it, and settle its
+        # futures. Several resolvers run CONCURRENTLY — sound because the
+        # busy sets guarantee in-flight rounds touch disjoint partition
+        # slots (per-slot ordering is the only ordering the settle path
+        # needs, and the store/replication streams only require per-slot
+        # record order — replay is per-slot later-wins). Concurrency
+        # matters when the chip sits behind a network tunnel: each host
+        # fetch costs a full ~70 ms RTT even for already-computed values,
+        # and serial resolves would cap round throughput at 1/RTT. The
+        # round's `base` is NOT fetched at all: it is the drain-time
+        # log-end shadow (exact — one in-flight round per slot, and
+        # log_end only moves on commit), captured in the round ctx. The
+        # bounded queue backpressures dispatch at `pipeline_depth`
+        # outstanding rounds.
         import queue as _queue
 
         self.pipeline_depth = max(1, pipeline_depth)
+        self.resolver_threads = max(1, resolver_threads)
         # Coalescing window: when few submissions are pending, wait this
         # long before dispatching so a whole burst of concurrent
         # producers lands in ONE round — every round costs a full
@@ -231,12 +236,20 @@ class DataPlane:
         self._inflight: "_queue.Queue[tuple[StepInput, dict, object]]" = (
             _queue.Queue(maxsize=self.pipeline_depth)
         )
-        self._resolver = threading.Thread(
-            target=self._resolve_loop, daemon=True, name="dataplane-resolve"
-        )
+        self._resolvers = [
+            threading.Thread(
+                target=self._resolve_loop, daemon=True,
+                name=f"dataplane-resolve-{i}",
+            )
+            for i in range(self.resolver_threads)
+        ]
         # Guarded by self._lock (read by _drain, cleared by the resolver).
         self._busy_a: set[int] = set()   # partition slots with appends in flight
         self._busy_o: set[int] = set()   # ... with offset commits in flight
+        # Slots whose log-end shadow must be re-read from the device
+        # before their next round (a resolve failed with the round's
+        # outcome possibly unknown). Guarded by self._lock.
+        self._shadow_dirty: set[int] = set()
         # Host-side counters (exposed through the broker's admin.stats RPC).
         self.rounds = 0
         self.committed_entries = 0
@@ -244,13 +257,15 @@ class DataPlane:
 
     def start(self) -> None:
         self._thread.start()
-        self._resolver.start()
+        for r in self._resolvers:
+            r.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
         self._thread.join(timeout=5)
-        self._resolver.join(timeout=10)  # lands every dispatched round
+        for r in self._resolvers:
+            r.join(timeout=10)  # lands every dispatched round
         if self.store is not None:
             self.store.flush()
         # Nothing will ever drain the queues again: fail leftovers instead
@@ -595,6 +610,17 @@ class DataPlane:
         with self._lock:
             if not self._appends and not self._offsets:
                 return None
+            dirty = self._shadow_dirty & set(self._appends)
+        if dirty:
+            # Re-derive failed-resolve slots' shadow from the device (one
+            # fetch covers all of them; their values are stable — a dirty
+            # slot is never busy when drained).
+            ends = self.log_ends().max(axis=0)
+            with self._lock:
+                for s in dirty:
+                    self._log_end[s] = int(ends[s])
+                self._shadow_dirty -= dirty
+        with self._lock:
             entries = np.zeros((P, B, SB), np.uint8)
             counts = np.zeros((P,), np.int32)
             off_slots = np.zeros((P, U), np.int32)
@@ -603,6 +629,9 @@ class DataPlane:
             # round_appends: slot -> [(pending, start, n)] taken this round
             round_appends: dict[int, list[tuple[_Pending, int, int]]] = {}
             round_offsets: dict[int, list[_PendingOffsets]] = {}
+            # Drain-time log-end shadow per append slot — the round's
+            # base, known without a device fetch (see pipeline comment).
+            round_bases: dict[int, int] = {}
 
             S = cfg.slots
             can_trim = self.store is not None and self.log_index is not None
@@ -651,6 +680,7 @@ class DataPlane:
                     entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
                     counts[slot] = fill
                     round_appends[slot] = taken
+                    round_bases[slot] = end
                 elif queue and can_trim:
                     # The queue head cannot fit before the ring boundary:
                     # submit a boundary-padding round (length-0 rows carry
@@ -660,6 +690,7 @@ class DataPlane:
                     entries[slot] = pack_rows(cfg, [], int(self.term[slot]))
                     counts[slot] = pad
                     round_appends[slot] = []
+                    round_bases[slot] = end
                 if not queue:
                     self._appends.pop(slot, None)
 
@@ -696,6 +727,7 @@ class DataPlane:
             quorum = self.quorum.copy()
             trim = self.trim.astype(np.int32)
         return inp, {"appends": round_appends, "offsets": round_offsets,
+                     "bases": round_bases,
                      "alive": alive, "quorum": quorum, "trim": trim}
 
     def _run(self) -> None:
@@ -730,10 +762,10 @@ class DataPlane:
                         ctx["trim"],
                     )
                 self.rounds += 1
-                for leaf in (out.base, out.committed):
-                    start_async = getattr(leaf, "copy_to_host_async", None)
-                    if start_async is not None:
-                        start_async()  # overlap D2H with later rounds
+                start_async = getattr(out.committed, "copy_to_host_async",
+                                      None)
+                if start_async is not None:
+                    start_async()  # overlap D2H with later rounds
                 with self._lock:
                     self._busy_a |= ctx["appends"].keys()
                     self._busy_o |= ctx["offsets"].keys()
@@ -743,7 +775,8 @@ class DataPlane:
             except Exception as e:  # the step thread must never die: fail
                 # this round's futures and keep serving (one bad round must
                 # not wedge the whole data plane).
-                self.step_errors += 1
+                with self._lock:  # counters race the resolver threads
+                    self.step_errors += 1
                 log.warning("step thread error: %s: %s", type(e).__name__, e)
                 if ctx is not None:
                     with self._lock:
@@ -752,7 +785,10 @@ class DataPlane:
                     self._fail_round(ctx, e)
 
     def _resolve_loop(self) -> None:
-        """Resolver thread: land rounds in dispatch order."""
+        """Resolver thread: land rounds — several run concurrently, so
+        landing order is only guaranteed PER SLOT (in-flight rounds touch
+        disjoint slots; see the pipeline comment in __init__), not across
+        slots."""
         import queue as _queue
 
         while True:
@@ -770,23 +806,31 @@ class DataPlane:
         until AFTER _settle so retry requeues land at the queue front
         before drain can take later submits for the same slot."""
         try:
-            base = np.asarray(out.base)
-            committed = np.asarray(out.committed)
-            records = self._round_records(inp, ctx, base, committed)
-            self._persist_round(records)
+            committed = np.asarray(out.committed)  # the ONE device fetch
+            base = ctx["bases"]  # drain-time shadow (see pipeline comment)
             # Advance the absolute-log-end shadow for this round's
-            # committed appends (exact: one in-flight round per slot).
+            # committed appends FIRST (exact: one in-flight round per
+            # slot): the device already advanced, so a failure in the
+            # fallible work below (persist/replicate) must not leave the
+            # shadow behind — every later round's base would be wrong.
             counts = np.asarray(inp.counts)
             with self._lock:
                 for slot in ctx["appends"]:
                     if committed[slot] and counts[slot] > 0:
                         adv = -(-int(counts[slot]) // ALIGN) * ALIGN
                         self._log_end[slot] = int(base[slot]) + adv
+            records = self._round_records(inp, ctx, base, committed)
+            self._persist_round(records)
             if self.replicate_fn is not None and records:
                 self.replicate_fn(records)
             self._settle(ctx, base, committed)
         except Exception as e:
-            self.step_errors += 1
+            with self._lock:
+                self.step_errors += 1
+                # The round's device outcome may be unknown (e.g. the
+                # committed fetch itself failed): re-derive these slots'
+                # shadow from the device before their next round.
+                self._shadow_dirty |= ctx["appends"].keys()
             log.warning("round resolve error: %s: %s", type(e).__name__, e)
             self._fail_round(ctx, e)
         finally:
@@ -794,9 +838,11 @@ class DataPlane:
                 self._busy_a -= ctx["appends"].keys()
                 self._busy_o -= ctx["offsets"].keys()
 
-    def _round_records(self, inp: StepInput, ctx, base, committed
+    def _round_records(self, inp: StepInput, ctx, base: dict, committed
                        ) -> list[tuple[int, int, int, bytes]]:
-        """This round's committed writes as store/replication records."""
+        """This round's committed writes as store/replication records.
+        `base` maps append slot -> the round's base offset (drain-time
+        shadow)."""
         records: list[tuple[int, int, int, bytes]] = []
         entries = np.asarray(inp.entries)
         counts = np.asarray(inp.counts)
@@ -857,13 +903,14 @@ class DataPlane:
                 if not pend.future.done():
                     pend.future.set_exception(exc)
 
-    def _settle(self, ctx, base, committed) -> None:
+    def _settle(self, ctx, base: dict, committed) -> None:
         requeue_a: list[tuple[int, _Pending]] = []
         requeue_o: list[tuple[int, _PendingOffsets]] = []
+        new_entries = 0  # counted locally; resolvers run concurrently
         for slot, taken in ctx["appends"].items():
             if committed[slot]:
                 for pend, start, n in taken:
-                    self.committed_entries += n
+                    new_entries += n
                     if not pend.future.done():
                         pend.future.set_result(int(base[slot]) + start)
             else:
@@ -946,6 +993,9 @@ class DataPlane:
                             )
                     else:
                         requeue_o.append((slot, pend))
+        if new_entries:
+            with self._lock:
+                self.committed_entries += new_entries
         if requeue_a or requeue_o:
             with self._lock:
                 for slot, pend in reversed(requeue_a):
