@@ -782,6 +782,11 @@ class DataPlane:
                     with self._lock:
                         self._busy_a -= ctx["appends"].keys()
                         self._busy_o -= ctx["offsets"].keys()
+                        # The failure may postdate device dispatch (e.g.
+                        # the D2H copy kickoff raised on a dropped link),
+                        # so the round's outcome is unknown: re-derive
+                        # these slots' shadow before their next round.
+                        self._shadow_dirty |= ctx["appends"].keys()
                     self._fail_round(ctx, e)
 
     def _resolve_loop(self) -> None:

@@ -95,6 +95,15 @@ class BrokerServer:
         self._started = False
         self.data_dir = data_dir
 
+        # --- transports (before the store: boot-time shard refill calls
+        # out to live peers) ---
+        if net is not None:
+            self.client: Transport = net.client(self.addr)
+            self._tcp_server = None
+        else:
+            self.client = TcpClient()
+            self._tcp_server = TcpServer(self.info.host, self.info.port, self.dispatch)
+
         # --- committed-round store ---
         # EVERY broker holds one, so any broker can serve as a replication
         # standby and take over as controller (broker/replication.py).
@@ -105,7 +114,12 @@ class BrokerServer:
         # partition data: process memory + replication,
         # PartitionStateMachine.java:26-27).
         self._store_dir = None
+        self._peer_shard_dir = None
         self._owns_store = dataplane is None
+        self._pushed_shards: set[str] = set()
+        self._bad_shard_targets: set[int] = set()
+        self._shard_push_seeded = False
+        self._last_shard_push = 0.0
         if dataplane is not None:
             self._round_store = dataplane.store  # may be None
         elif data_dir is not None:
@@ -115,24 +129,25 @@ class BrokerServer:
             from ripplemq_tpu.storage.segment import SegmentStore
 
             self._store_dir = os.path.join(data_dir, "segments")
-            # Heal erasure-protected sealed segments BEFORE opening for
-            # append (a missing/corrupt sealed segment rebuilds from any
-            # 3 of its 5 RS shards).
+            self._peer_shard_dir = os.path.join(data_dir, "rs_peer")
+            # Disaster path first: sealed segments whose file AND local
+            # shards are gone refill their rs/ sets from peer-held shard
+            # copies (best-effort — unreachable peers just skip), then
+            # the ordinary local heal rebuilds any missing/corrupt sealed
+            # segment from any 3 of its 5 RS shards — all BEFORE opening
+            # for append (the open creates a fresh active segment whose
+            # index must come after every recovered one).
+            self._refill_shards_from_peers()
             repair_store(self._store_dir)
-            self._round_store = SegmentStore(self._store_dir, erasure=True)
+            self._round_store = SegmentStore(
+                self._store_dir, erasure=True,
+                segment_bytes=config.segment_bytes,
+            )
         else:
             from ripplemq_tpu.storage.memstore import MemoryRoundStore
 
             self._round_store = MemoryRoundStore()
         self._repl_last_flush = 0.0
-
-        # --- transports ---
-        if net is not None:
-            self.client: Transport = net.client(self.addr)
-            self._tcp_server = None
-        else:
-            self.client = TcpClient()
-            self._tcp_server = TcpServer(self.info.host, self.info.port, self.dispatch)
 
         # --- control plane (the dataplane attaches after, since the
         # restored metadata decides who the controller is) ---
@@ -333,6 +348,8 @@ class BrokerServer:
                 return self._handle_repl_rounds(req)
             if t == "admin.stats":
                 return self._handle_stats(req)
+            if t.startswith("shard."):
+                return self._handle_shard(t, req)
             if t.startswith("engine."):
                 return self._handle_engine(t, req)
             return {"ok": False, "error": f"unknown request type {t!r}"}
@@ -411,6 +428,199 @@ class BrokerServer:
                 engine["slots"] = detail
             stats["engine"] = engine
         return stats
+
+    # -- distributed erasure shards ---------------------------------------
+    # Each broker pushes its sealed segments' RS shards to peers (round-
+    # robin over the roster), and on boot refills missing shard sets from
+    # peers before the local repair pass — so losing a broker's disk
+    # entirely (segments AND local shards) is recoverable from any K of
+    # the K+M distributed shard copies. The reference's only equivalent
+    # is full per-broker replication (PartitionRaftServer.java:88-90);
+    # this gets the same any-K-of-N durability at (K+M)/K x overhead.
+
+    def _peer_dir_for(self, owner: int) -> Optional[str]:
+        if self._peer_shard_dir is None:
+            return None
+        import os
+
+        return os.path.join(self._peer_shard_dir, f"broker-{int(owner)}")
+
+    def _handle_shard(self, t: str, req: dict) -> dict:
+        import os
+
+        from ripplemq_tpu.storage.erasure import valid_shard_name
+
+        d = self._peer_dir_for(int(req["owner"]))
+        if d is None:
+            return {"ok": False, "error": "no_data_dir"}
+        if t == "shard.put":
+            name = str(req["name"])
+            if not valid_shard_name(name):
+                return {"ok": False, "error": f"bad shard name {name!r}"}
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, name + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(req["data"])
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(d, name))
+            return {"ok": True}
+        if t == "shard.list":
+            names = []
+            if os.path.isdir(d):
+                names = sorted(
+                    f for f in os.listdir(d)
+                    if valid_shard_name(f)
+                )
+            return {"ok": True, "shards": names}
+        if t == "shard.get":
+            name = str(req["name"])
+            if not valid_shard_name(name):
+                return {"ok": False, "error": f"bad shard name {name!r}"}
+            try:
+                with open(os.path.join(d, name), "rb") as f:
+                    return {"ok": True, "data": f.read()}
+            except OSError:
+                return {"ok": False, "error": "not_found"}
+        return {"ok": False, "error": f"unknown shard op {t!r}"}
+
+    def _refill_shards_from_peers(self) -> None:
+        """Boot-time disaster recovery: pull peer-held shard copies for
+        sealed segments this store lost (see refill_from_peers). Gated on
+        LOCAL loss evidence — a hole in the store's contiguous segment
+        numbering — so ordinary boots (including cold cluster starts,
+        where peers aren't serving yet) skip the peer round-trips
+        entirely. A fully wiped data dir shows no holes and recovers
+        through the committed-round replication stream instead
+        (broker/replication.py standby catch-up)."""
+        from ripplemq_tpu.storage.erasure import (
+            refill_from_peers,
+            segment_index_gaps,
+        )
+
+        if not segment_index_gaps(self._store_dir):
+            return
+        peers = [
+            b for b in self.config.brokers if b.broker_id != self.broker_id
+        ]
+        if not peers:
+            return
+
+        def mk_list(addr):
+            def f():
+                resp = self.client.call(
+                    addr, {"type": "shard.list", "owner": self.broker_id},
+                    timeout=2.0,
+                )
+                return resp.get("shards", []) if resp.get("ok") else []
+            return f
+
+        def get(addr, name):
+            resp = self.client.call(
+                addr,
+                {"type": "shard.get", "owner": self.broker_id, "name": name},
+                timeout=5.0,
+            )
+            return resp.get("data") if resp.get("ok") else None
+
+        try:
+            refilled = refill_from_peers(
+                self._store_dir,
+                [(b.address, mk_list(b.address)) for b in peers],
+                get,
+            )
+        except Exception as e:  # never block boot on the disaster path
+            log.warning("broker %d: shard refill failed: %s: %s",
+                        self.broker_id, type(e).__name__, e)
+            return
+        if refilled:
+            log.info("broker %d: refilled shard sets from peers for %s",
+                     self.broker_id, refilled)
+
+    def _seed_pushed_shards(self) -> None:
+        """One-time (per boot) sync of the pushed-set with what peers
+        already hold, so a restart does not re-transfer the whole sealed
+        history."""
+        for b in self.config.brokers:
+            if b.broker_id == self.broker_id:
+                continue
+            try:
+                resp = self.client.call(
+                    b.address,
+                    {"type": "shard.list", "owner": self.broker_id},
+                    timeout=2.0,
+                )
+            except RpcError:
+                continue  # unreachable: worst case a redundant re-push
+            if resp.get("ok"):
+                self._pushed_shards.update(resp.get("shards", []))
+
+    def _shard_duty(self) -> None:
+        """Push not-yet-distributed local shard files to their designated
+        peers (shard i of a segment goes to the (i+1)-th broker after
+        this one in the roster — with K+M=5 shards and >=5 brokers each
+        lands on a distinct peer). Work per tick is bounded by ATTEMPTS
+        (a partitioned peer's timeouts must not stall the duty loop that
+        also runs failover duties), and peers that refuse storage
+        (no_data_dir) rotate to the next roster member."""
+        if self._store_dir is None:
+            return
+        now = time.monotonic()
+        if now - self._last_shard_push < 2.0:
+            return
+        protect = getattr(self._round_store, "protect_async", None)
+        if protect is not None:
+            protect()  # traffic-independent encode trigger (see method)
+        if not self._shard_push_seeded:
+            self._shard_push_seeded = True
+            self._seed_pushed_shards()
+        self._last_shard_push = now
+        import os
+
+        from ripplemq_tpu.storage.erasure import shard_file_names
+
+        roster = [b.broker_id for b in self.config.brokers]
+        if len(roster) < 2:
+            return
+        my = roster.index(self.broker_id)
+        attempts = 0
+        for name in shard_file_names(self._store_dir):
+            if name in self._pushed_shards:
+                continue
+            if attempts >= 4:
+                break  # bound per-tick work/stall (duty loop is shared)
+            idx = int(name.rpartition(".shard")[2])
+            candidates = [
+                roster[(my + 1 + idx + k) % len(roster)]
+                for k in range(len(roster))
+            ]
+            targets = [
+                t for t in candidates
+                if t != self.broker_id and t not in self._bad_shard_targets
+            ]
+            if not targets:
+                break  # every peer refuses storage; nothing to do
+            path = os.path.join(self._store_dir, "rs", name)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue
+            attempts += 1
+            try:
+                resp = self.client.call(
+                    self._addr_of(targets[0]),
+                    {"type": "shard.put", "owner": self.broker_id,
+                     "name": name, "data": blob},
+                    timeout=self.config.rpc_timeout_s,
+                )
+            except RpcError:
+                continue  # peer down; retried next pass
+            if resp.get("ok"):
+                self._pushed_shards.add(name)
+            elif resp.get("error") == "no_data_dir":
+                # Storage-less peer: never a valid target.
+                self._bad_shard_targets.add(targets[0])
 
     # -- metadata ----------------------------------------------------------
 
@@ -714,6 +924,7 @@ class BrokerServer:
                 self._takeover_duty()
                 self._controller_duty()
                 self._standby_duty()
+                self._shard_duty()
             except Exception as e:  # duties must never kill the loop
                 log.warning("broker %d duty error: %s: %s",
                             self.broker_id, type(e).__name__, e)

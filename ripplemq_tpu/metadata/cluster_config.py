@@ -55,6 +55,22 @@ class ClusterConfig:
     # stream (the data plane survives the loss of the controller plus
     # standby_count - 1 standbys). 0 disables controller failover.
     standby_count: int = 2
+    # Round-store segment rotation threshold (sealed segments are
+    # erasure-coded and their shards distributed to peer brokers).
+    segment_bytes: int = 64 << 20
+
+    def __post_init__(self) -> None:
+        # Shards (~segment_bytes / 3 each) travel in single wire frames
+        # (shard.put / shard.get), which the codec hard-caps at 64 MB —
+        # an oversize segment would make shard distribution fail forever.
+        max_seg = 3 * (48 << 20)
+        if self.segment_bytes > max_seg:
+            raise ValueError(
+                f"segment_bytes={self.segment_bytes} too large: shards "
+                f"must fit a wire frame (max {max_seg})"
+            )
+        if self.segment_bytes < 4096:
+            raise ValueError("segment_bytes must be at least 4096")
 
     @property
     def controller(self) -> int:
@@ -135,4 +151,6 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["controller_id"] = int(raw["controller_id"])
     if "standby_count" in raw:
         extra["standby_count"] = int(raw["standby_count"])
+    if "segment_bytes" in raw:
+        extra["segment_bytes"] = int(raw["segment_bytes"])
     return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **extra)

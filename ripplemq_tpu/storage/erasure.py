@@ -199,6 +199,119 @@ def protect_store(store_dir: str, limit: Optional[int] = None,
     return done
 
 
+def shard_file_names(store_dir: str) -> list[str]:
+    """Names of every shard file in the store's rs/ dir (push duty)."""
+    rs_dir = _rs_dir(store_dir)
+    if not os.path.isdir(rs_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(rs_dir)
+        if ".shard" in f and not f.endswith(".tmp")
+    )
+
+
+def valid_shard_name(name: str) -> bool:
+    """Guard for wire-supplied shard file names (path-traversal safety +
+    shape check before anything touches the filesystem)."""
+    stem, _, suffix = name.rpartition(".shard")
+    return (
+        bool(stem)
+        and suffix.isdigit()
+        and int(suffix) < K + M
+        and stem.startswith("segment-")
+        and stem.endswith(".log")
+        and "/" not in name
+        and "\\" not in name
+        and ".." not in name
+    )
+
+
+def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
+    """Re-populate rs/ with peer-held shard copies for sealed segments
+    MISSING from this store, so the ordinary repair_store pass can
+    rebuild them — the disaster path when a broker lost both a segment
+    and its local shards (the reference survives this only because every
+    broker fully replicates every partition it hosts,
+    PartitionRaftServer.java:88-90; here any K of the K+M distributed
+    shards suffice at (K+M)/K x overhead).
+
+    `list_fns` is [(peer_tag, callable() -> shard file names held for
+    this owner)], `get_fn(peer_tag, name) -> bytes | None`. Fetched blobs
+    are CRC-validated by the shard reader before being trusted; invalid
+    or unsafe names are skipped. Best-effort: unreachable peers are the
+    caller's problem to log. Returns the segment names refilled."""
+    # Which shard sets do peers hold that we cannot reconstruct locally?
+    # Keyed on local shard count < K, NOT on segment-file presence: a
+    # present-but-corrupt segment whose local shards were also lost is
+    # exactly as dead as a missing one, and only peer shards can save it
+    # (a present-and-healthy file costs at most K redundant fetches —
+    # repair validates health before rewriting anything).
+    remote: dict[str, list[tuple[str, str]]] = {}  # seg -> [(peer, fname)]
+    for peer, list_fn in list_fns:
+        try:
+            names = list_fn()
+        except Exception:
+            continue
+        for fname in names:
+            if not valid_shard_name(fname):
+                continue
+            stem = fname.rpartition(".shard")[0]
+            remote.setdefault(stem, []).append((peer, fname))
+    refilled = []
+    rs_dir = _rs_dir(store_dir)
+    for stem, sources in sorted(remote.items()):
+        # VALID local shards only — a corrupt shard file present on disk
+        # must not count toward reconstructability.
+        have = sum(
+            1 for p in shard_paths(store_dir, stem)
+            if _read_shard(p) is not None
+        )
+        if have >= K:
+            continue  # locally reconstructable already
+        got = 0
+        seen_idx: set[int] = set()
+        for peer, fname in sources:
+            if have + got >= K:
+                break  # K shards reconstruct; repair re-encodes the rest
+            idx = int(fname.rpartition(".shard")[2])
+            if idx in seen_idx or os.path.exists(os.path.join(rs_dir, fname)):
+                seen_idx.add(idx)
+                continue
+            try:
+                blob = get_fn(peer, fname)
+            except Exception:
+                continue
+            if not blob:
+                continue
+            os.makedirs(rs_dir, exist_ok=True)
+            tmp = os.path.join(rs_dir, fname + ".tmp")
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            if _read_shard(tmp) is None:  # CRC/shape reject
+                os.remove(tmp)
+                continue
+            os.replace(tmp, os.path.join(rs_dir, fname))
+            seen_idx.add(idx)
+            got += 1
+        if got:
+            refilled.append(stem)
+    return refilled
+
+
+def segment_index_gaps(store_dir: str) -> bool:
+    """True when the store's segment numbering has holes (indices start
+    at 0 and rotate contiguously, so a hole means a sealed segment FILE
+    was lost) — the cheap local evidence that gates boot-time peer
+    refill."""
+    names = _segment_names(store_dir)
+    if not names:
+        return False
+    indices = {int(n[8:16]) for n in names}
+    return indices != set(range(max(indices) + 1))
+
+
 def repair_store(store_dir: str, **kw) -> list[str]:
     """Rebuild sealed segment files that are missing or fail their shard-
     recorded CRC. Called before replay (recover_image). Best-effort by

@@ -231,6 +231,14 @@ class SegmentStore:
             self.erasure_errors.append(f"{type(e).__name__}: {e}")
             del self.erasure_errors[:-20]
 
+    def protect_async(self) -> None:
+        """Kick the background sealed-segment encoder. Duty loops call
+        this periodically: flush() also kicks it, but flushes stop with
+        write traffic, and a burst's final sealed segments must not stay
+        unprotected until the next burst."""
+        if self.erasure:
+            self._kick_erasure()
+
     def wait_erasure(self, timeout: Optional[float] = None) -> None:
         """Join an in-flight background encode (tests / orderly shutdown)."""
         t = self._erasure_thread
