@@ -1,0 +1,36 @@
+# Broker runtime image — the counterpart of the reference's three-stage
+# Maven build (reference: mq-broker/Dockerfile:1-52). One stage suffices
+# here: the only compiled artifact is the native segment store, which the
+# broker builds on demand from the checked-in C++ (storage/segment.py
+# compiles native/segstore.cpp with g++ at first use and caches the .so).
+#
+# CPU image by default (functional everywhere: the engine's XLA programs
+# run on the host platform). For TPU hosts, swap the pip line for the
+# libtpu build, e.g.:  pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+FROM python:3.12-slim
+
+# g++ for the native segment store; no other system deps.
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+# Pinned to the versions this tree is developed/tested against — the
+# engine leans on jax.experimental APIs (Pallas, shard_map) that churn
+# between releases.
+RUN pip install --no-cache-dir "jax==0.9.0" "numpy==2.0.2" "pyyaml==6.0.3"
+
+WORKDIR /app
+COPY ripplemq_tpu /app/ripplemq_tpu
+COPY native /app/native
+COPY examples /app/examples
+ENV PYTHONPATH=/app
+
+# Durable state (round-store segments, RS shards, peer shard copies,
+# metadata snapshots) lives under /data — mount a volume per broker.
+VOLUME /data
+
+# docker-compose supplies --id per service (the reference passes -id the
+# same way, docker-compose.yml command: ["-id", "N"]).
+ENTRYPOINT ["python", "-m", "ripplemq_tpu.broker", \
+            "--config", "/app/examples/cluster.docker.yaml", \
+            "--data-dir", "/data"]
