@@ -122,6 +122,7 @@ class DataPlane:
         workers: Optional[list[str]] = None,
         worker_client=None,
         resolver_threads: int = 4,
+        chain_depth: int = 4,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -227,6 +228,13 @@ class DataPlane:
 
         self.pipeline_depth = max(1, pipeline_depth)
         self.resolver_threads = max(1, resolver_threads)
+        # Deep backlogs drain as CHAINS of up to chain_depth rounds per
+        # device dispatch (engine step_many: lax.scan over complete
+        # quorum rounds). Dispatch latency and the resolver's host fetch
+        # both amortize over the chain; a chain may take several pendings
+        # of one slot (device-ordered). 1 disables chaining.
+        self.chain_depth = max(1, chain_depth)
+        self._zero_round = None  # lazy pad template (chain dispatches)
         # Coalescing window: when few submissions are pending, wait this
         # long before dispatching so a whole burst of concurrent
         # producers lands in ONE round — every round costs a full
@@ -604,9 +612,18 @@ class DataPlane:
     # ---------------------------------------------------------- step thread
 
     def _drain(self) -> Optional[tuple[StepInput, dict]]:
-        """Build one round's StepInput from the queues. Returns None if idle."""
+        """Build one dispatch's worth of rounds from the queues — up to
+        `chain_depth` CHAINED rounds when the backlog is deep (one
+        device launch commits them all via the engine's scan path; see
+        parallel.engine step_many). Returns None if idle.
+
+        Chained rounds may take several pendings of the SAME slot (the
+        device executes the chain in order, so per-slot FIFO holds). The
+        per-slot committed-prefix property of a chain (alive/quorum/trim
+        are chain-constant, so once a slot's round fails every later one
+        does too) makes the predicted bases exact for every committed
+        round."""
         cfg = self.cfg
-        P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
         with self._lock:
             if not self._appends and not self._offsets:
                 return None
@@ -621,114 +638,191 @@ class DataPlane:
                     self._log_end[s] = int(ends[s])
                 self._shadow_dirty -= dirty
         with self._lock:
-            entries = np.zeros((P, B, SB), np.uint8)
-            counts = np.zeros((P,), np.int32)
-            off_slots = np.zeros((P, U), np.int32)
-            off_vals = np.zeros((P, U), np.int32)
-            off_counts = np.zeros((P,), np.int32)
-            # round_appends: slot -> [(pending, start, n)] taken this round
-            round_appends: dict[int, list[tuple[_Pending, int, int]]] = {}
-            round_offsets: dict[int, list[_PendingOffsets]] = {}
-            # Drain-time log-end shadow per append slot — the round's
-            # base, known without a device fetch (see pipeline comment).
-            round_bases: dict[int, int] = {}
-
-            S = cfg.slots
-            can_trim = self.store is not None and self.log_index is not None
-            for slot, queue in list(self._appends.items()):
-                if slot in self._busy_a:
-                    continue  # one in-flight round per slot (ordering)
-                end = int(self._log_end[slot])
-                if end >= _OFFSET_HORIZON:
-                    # Authoritative horizon check (submit_append's check
-                    # races a deep backlog: it compares against a shadow
-                    # that only advances at resolve time). `end` here is
-                    # exact — the slot is not busy.
-                    for pend in queue:
-                        if not pend.future.done():  # caller may cancel()
-                            pend.future.set_exception(PartitionFullError(
-                                f"partition {slot} reached the int32 "
-                                f"offset horizon; re-key onto another "
-                                f"partition"
-                            ))
-                    self._appends.pop(slot, None)
-                    continue
-                if can_trim:
-                    # Lazy retention: raise the trim watermark just enough
-                    # for a full window past the current end. Everything
-                    # below `end` is persisted (the slot is not busy), so
-                    # trimmed rows remain servable from the store.
-                    needed = end + B - S
-                    if needed > self.trim[slot]:
-                        self.trim[slot] = needed
-                    # Rounds must never lap the ring boundary (live rows
-                    # would land in the wrap margin): cap this round's
-                    # batch at the rows left before the boundary.
-                    cap = min(B, S - end % S)
-                else:
-                    cap = B  # store-less: bounded log, old behavior
-                taken: list[tuple[_Pending, int, int]] = []
-                fill = 0
-                batch: list[bytes] = []
-                while queue and fill + len(queue[0].payloads) <= cap:
-                    pend = queue.pop(0)
-                    n = len(pend.payloads)
-                    taken.append((pend, fill, n))
-                    batch.extend(pend.payloads)
-                    fill += n
-                if taken:
-                    entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
-                    counts[slot] = fill
-                    round_appends[slot] = taken
-                    round_bases[slot] = end
-                elif queue and can_trim:
-                    # The queue head cannot fit before the ring boundary:
-                    # submit a boundary-padding round (length-0 rows carry
-                    # the term; decode skips them) so the next round
-                    # starts the lap at ring position 0.
-                    pad = S - end % S  # < B here (head <= B did not fit)
-                    entries[slot] = pack_rows(cfg, [], int(self.term[slot]))
-                    counts[slot] = pad
-                    round_appends[slot] = []
-                    round_bases[slot] = end
-                if not queue:
-                    self._appends.pop(slot, None)
-
-            for slot, queue in list(self._offsets.items()):
-                if slot in self._busy_o:
-                    continue
-                taken_off: list[_PendingOffsets] = []
-                fill = 0
-                while queue and fill + len(queue[0].payloads) <= U:
-                    pend = queue.pop(0)
-                    for i, (cslot, off) in enumerate(pend.payloads):
-                        off_slots[slot, fill + i] = cslot
-                        off_vals[slot, fill + i] = off
-                    fill += len(pend.payloads)
-                    taken_off.append(pend)
-                if taken_off:
-                    off_counts[slot] = fill
-                    round_offsets[slot] = taken_off
-                if not queue:
-                    self._offsets.pop(slot, None)
-
-            if not round_appends and not round_offsets:
+            pred_end: dict[int, int] = {}
+            rounds = []
+            for _ in range(self.chain_depth):
+                r = self._build_round_locked(pred_end)
+                if r is None:
+                    break
+                rounds.append(r)
+            if not rounds:
                 return None
-            inp = StepInput(
-                entries=entries,
-                counts=counts,
-                off_slots=off_slots,
-                off_vals=off_vals,
-                off_counts=off_counts,
-                leader=self.leader.copy(),
-                term=self.term.copy(),
-            )
             alive = self.alive.copy()
             quorum = self.quorum.copy()
             trim = self.trim.astype(np.int32)
-        return inp, {"appends": round_appends, "offsets": round_offsets,
-                     "bases": round_bases,
+            if len(rounds) > 1:
+                # Pad to exactly chain_depth rounds (all-zero rounds
+                # carry no work and commit nothing) so only TWO programs
+                # ever compile: the single round and the full chain.
+                # Zero tensors are a shared cached template (np.stack
+                # below copies them out; nothing ever writes them), and
+                # the leader/term snapshot happens HERE, under the lock,
+                # consistent with the chain's real rounds.
+                zero = self._zero_round_template()
+                pad_inp = StepInput(*zero, leader=self.leader.copy(),
+                                    term=self.term.copy())
+                while len(rounds) < self.chain_depth:
+                    rounds.append((
+                        pad_inp, {"appends": {}, "offsets": {}, "bases": {}}
+                    ))
+        if len(rounds) == 1:
+            inp, _ = rounds[0]
+        else:
+            inp = StepInput(*[
+                np.stack([np.asarray(getattr(r[0], f)) for r in rounds])
+                for f in StepInput._fields
+            ])
+        chain = [r[1] for r in rounds]
+        # Top-level unions drive busy bookkeeping and whole-dispatch
+        # failure paths (_fail_round, shadow-dirty marking).
+        union_a: dict[int, list] = {}
+        union_o: dict[int, list] = {}
+        for rc in chain:
+            for slot, taken in rc["appends"].items():
+                union_a.setdefault(slot, []).extend(taken)
+            for slot, toff in rc["offsets"].items():
+                union_o.setdefault(slot, []).extend(toff)
+        return inp, {"chain": chain, "appends": union_a, "offsets": union_o,
                      "alive": alive, "quorum": quorum, "trim": trim}
+
+    def _zero_round_template(self):
+        """Shared all-zero (entries, counts, off_slots, off_vals,
+        off_counts) arrays for chain padding — read-only by contract
+        (np.stack copies them into the dispatch tensor)."""
+        if self._zero_round is None:
+            cfg = self.cfg
+            P, B, SB, U = (cfg.partitions, cfg.max_batch, cfg.slot_bytes,
+                           cfg.max_offset_updates)
+            self._zero_round = (
+                np.zeros((P, B, SB), np.uint8),
+                np.zeros((P,), np.int32),
+                np.zeros((P, U), np.int32),
+                np.zeros((P, U), np.int32),
+                np.zeros((P,), np.int32),
+            )
+        return self._zero_round
+
+    def _build_round_locked(self, pred_end: dict[int, int]):
+        """Build ONE round from the queues (caller holds self._lock).
+        `pred_end` carries the chain's predicted per-slot log ends —
+        exact for committed rounds by the chain prefix property. Returns
+        (StepInput, round_ctx) or None if nothing drainable remains."""
+        cfg = self.cfg
+        P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
+        entries = np.zeros((P, B, SB), np.uint8)
+        counts = np.zeros((P,), np.int32)
+        off_slots = np.zeros((P, U), np.int32)
+        off_vals = np.zeros((P, U), np.int32)
+        off_counts = np.zeros((P,), np.int32)
+        # round_appends: slot -> [(pending, start, n)] taken this round
+        round_appends: dict[int, list[tuple[_Pending, int, int]]] = {}
+        round_offsets: dict[int, list[_PendingOffsets]] = {}
+        # Drain-time log-end shadow per append slot — the round's
+        # base, known without a device fetch (see pipeline comment).
+        round_bases: dict[int, int] = {}
+
+        S = cfg.slots
+        can_trim = self.store is not None and self.log_index is not None
+        for slot, queue in list(self._appends.items()):
+            if slot in self._busy_a:
+                continue  # rounds of PRIOR dispatches stay ordered
+            end = pred_end.get(slot, int(self._log_end[slot]))
+            if end >= _OFFSET_HORIZON:
+                if slot in pred_end:
+                    # Predicted (an earlier chain round advanced it) —
+                    # not authoritative: if that round loses quorum the
+                    # real end stays below the horizon, so just stop
+                    # chaining this slot; the next dispatch re-checks
+                    # against the exact shadow.
+                    continue
+                # Authoritative horizon check (submit_append's check
+                # races a deep backlog: it compares against a shadow
+                # that only advances at resolve time). `end` here is
+                # exact — the slot is not busy and untouched this chain.
+                for pend in queue:
+                    if not pend.future.done():  # caller may cancel()
+                        pend.future.set_exception(PartitionFullError(
+                            f"partition {slot} reached the int32 "
+                            f"offset horizon; re-key onto another "
+                            f"partition"
+                        ))
+                self._appends.pop(slot, None)
+                continue
+            if can_trim:
+                # Lazy retention: raise the trim watermark just enough
+                # for a full window past the current end. Everything
+                # below `end` is persisted (the slot is not busy), so
+                # trimmed rows remain servable from the store.
+                needed = end + B - S
+                if needed > self.trim[slot]:
+                    self.trim[slot] = needed
+                # Rounds must never lap the ring boundary (live rows
+                # would land in the wrap margin): cap this round's
+                # batch at the rows left before the boundary.
+                cap = min(B, S - end % S)
+            else:
+                cap = B  # store-less: bounded log, old behavior
+            taken: list[tuple[_Pending, int, int]] = []
+            fill = 0
+            batch: list[bytes] = []
+            while queue and fill + len(queue[0].payloads) <= cap:
+                pend = queue.pop(0)
+                n = len(pend.payloads)
+                taken.append((pend, fill, n))
+                batch.extend(pend.payloads)
+                fill += n
+            if taken:
+                entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
+                counts[slot] = fill
+                round_appends[slot] = taken
+                round_bases[slot] = end
+                adv = -(-fill // ALIGN) * ALIGN
+                pred_end[slot] = end + adv
+            elif queue and can_trim:
+                # The queue head cannot fit before the ring boundary:
+                # submit a boundary-padding round (length-0 rows carry
+                # the term; decode skips them) so the next round
+                # starts the lap at ring position 0.
+                pad = S - end % S  # < B here (head <= B did not fit)
+                entries[slot] = pack_rows(cfg, [], int(self.term[slot]))
+                counts[slot] = pad
+                round_appends[slot] = []
+                round_bases[slot] = end
+                pred_end[slot] = end + pad
+            if not queue:
+                self._appends.pop(slot, None)
+
+        for slot, queue in list(self._offsets.items()):
+            if slot in self._busy_o:
+                continue
+            taken_off: list[_PendingOffsets] = []
+            fill = 0
+            while queue and fill + len(queue[0].payloads) <= U:
+                pend = queue.pop(0)
+                for i, (cslot, off) in enumerate(pend.payloads):
+                    off_slots[slot, fill + i] = cslot
+                    off_vals[slot, fill + i] = off
+                fill += len(pend.payloads)
+                taken_off.append(pend)
+            if taken_off:
+                off_counts[slot] = fill
+                round_offsets[slot] = taken_off
+            if not queue:
+                self._offsets.pop(slot, None)
+
+        if not round_appends and not round_offsets:
+            return None
+        inp = StepInput(
+            entries=entries,
+            counts=counts,
+            off_slots=off_slots,
+            off_vals=off_vals,
+            off_counts=off_counts,
+            leader=self.leader.copy(),
+            term=self.term.copy(),
+        )
+        return inp, {"appends": round_appends, "offsets": round_offsets,
+                     "bases": round_bases}
 
     def _run(self) -> None:
         """Step thread: drain → dispatch → hand off to the resolver."""
@@ -757,11 +851,20 @@ class DataPlane:
                     continue
                 inp, ctx = work
                 with self._device_lock:
-                    self._state, out = self.fns.step(
-                        self._state, inp, ctx["alive"], ctx["quorum"],
-                        ctx["trim"],
-                    )
-                self.rounds += 1
+                    if len(ctx["chain"]) == 1:
+                        self._state, out = self.fns.step(
+                            self._state, inp, ctx["alive"], ctx["quorum"],
+                            ctx["trim"],
+                        )
+                    else:
+                        self._state, out = self.fns.step_many(
+                            self._state, inp, ctx["alive"], ctx["quorum"],
+                            ctx["trim"],
+                        )
+                self.rounds += sum(
+                    1 for rc in ctx["chain"]
+                    if rc["appends"] or rc["offsets"]
+                )
                 start_async = getattr(out.committed, "copy_to_host_async",
                                       None)
                 if start_async is not None:
@@ -812,23 +915,50 @@ class DataPlane:
         before drain can take later submits for the same slot."""
         try:
             committed = np.asarray(out.committed)  # the ONE device fetch
-            base = ctx["bases"]  # drain-time shadow (see pipeline comment)
-            # Advance the absolute-log-end shadow for this round's
-            # committed appends FIRST (exact: one in-flight round per
-            # slot): the device already advanced, so a failure in the
-            # fallible work below (persist/replicate) must not leave the
-            # shadow behind — every later round's base would be wrong.
+            if committed.ndim == 1:
+                committed = committed[None]  # single round as a 1-chain
+            chain = ctx["chain"]
             counts = np.asarray(inp.counts)
+            if counts.ndim == 1:
+                counts = counts[None]
+            # Advance the absolute-log-end shadow for every committed
+            # append FIRST (the device already advanced; a failure in the
+            # fallible work below must not leave the shadow behind).
+            # Chain bases are exact for committed rounds (prefix
+            # property, see _drain).
             with self._lock:
-                for slot in ctx["appends"]:
-                    if committed[slot] and counts[slot] > 0:
-                        adv = -(-int(counts[slot]) // ALIGN) * ALIGN
-                        self._log_end[slot] = int(base[slot]) + adv
-            records = self._round_records(inp, ctx, base, committed)
+                for k, rc in enumerate(chain):
+                    for slot in rc["appends"]:
+                        if committed[k, slot] and counts[k, slot] > 0:
+                            adv = -(-int(counts[k, slot]) // ALIGN) * ALIGN
+                            self._log_end[slot] = rc["bases"][slot] + adv
+            records = []
+            for k, rc in enumerate(chain):
+                inp_k = (
+                    inp if len(chain) == 1
+                    else StepInput(*(np.asarray(leaf)[k] for leaf in inp))
+                )
+                records.extend(self._round_records(
+                    inp_k, rc, rc["bases"], committed[k]
+                ))
             self._persist_round(records)
             if self.replicate_fn is not None and records:
                 self.replicate_fn(records)
-            self._settle(ctx, base, committed)
+            # Settle in REVERSE round order: failed pendings requeue at
+            # the queue FRONT, so the earliest round's retries must be
+            # inserted last to land first. Pad charging belongs to the
+            # LAST chained round per slot (see _settle).
+            last_round = {
+                slot: k
+                for k, rc in enumerate(chain)
+                for slot in rc["appends"]
+            }
+            for k in range(len(chain) - 1, -1, -1):
+                rc = chain[k]
+                rc["charge_pads"] = {
+                    s for s in rc["appends"] if last_round[s] == k
+                }
+                self._settle(rc, rc["bases"], committed[k])
         except Exception as e:
             with self._lock:
                 self.step_errors += 1
@@ -956,10 +1086,15 @@ class DataPlane:
         # blocked queue head's retry budget: the head is what forced the
         # pad, and without this a quorum outage at the ring boundary would
         # regenerate failing pads forever while the producer's future
-        # hangs past max_retry_rounds.
+        # hangs past max_retry_rounds. `charge_pads` (chain dispatch)
+        # restricts charging to slots whose LAST chained round was the
+        # failed pad — if a later round of the same chain took the head,
+        # that round's own settle already charged it.
+        charge = ctx.get("charge_pads")
         pad_failures = [
             slot for slot, taken in ctx["appends"].items()
             if not taken and not committed[slot]
+            and (charge is None or slot in charge)
         ]
         if pad_failures:
             with self._lock:

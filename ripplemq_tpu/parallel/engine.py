@@ -38,6 +38,7 @@ except ImportError:  # pragma: no cover
 class LocalEngineFns(NamedTuple):
     init: Callable[[], ReplicaState]          # -> state with leading [R] axis
     step: Callable[..., tuple[ReplicaState, StepOutput]]
+    step_many: Callable[..., tuple[ReplicaState, StepOutput]]  # chained rounds
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_offset: Callable[..., jax.Array]
@@ -48,6 +49,7 @@ class LocalEngineFns(NamedTuple):
 class SpmdEngineFns(NamedTuple):
     init: Callable[[], ReplicaState]
     step: Callable[..., tuple[ReplicaState, StepOutput]]
+    step_many: Callable[..., tuple[ReplicaState, StepOutput]]
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_offset: Callable[..., jax.Array]
@@ -119,6 +121,37 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
                        default_quorum if quorum is None else quorum,
                        default_trim if trim is None else trim)
 
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_many_j(state, inputs: StepInput, alive, quorum, trim):
+        # K chained rounds in ONE dispatch: `inputs` leaves carry a
+        # leading chain axis [K, ...]. Dispatch latency (which dominates
+        # behind a network tunnel: ~ms per launch vs ~tens of µs of
+        # compute for a small round) amortizes over the chain; each scan
+        # iteration is a COMPLETE quorum round — ballot before write,
+        # atomic, commit advanced — so chaining changes throughput, not
+        # semantics. alive/quorum/trim are chain-constant, which gives
+        # the per-slot committed-prefix property the host batcher relies
+        # on (broker.dataplane burst drain): once a slot's round fails
+        # (quorum/capacity under fixed conditions), every later round of
+        # the chain fails too.
+        def body(st, inp):
+            new_st, ctl = vctrl(st, inp, rep_idx, alive, quorum, trim)
+            log = append_rows(
+                st.log_data, inp.entries, ctl.out.base[0] % cfg.slots,
+                ctl.do_write
+            )
+            return (
+                new_st._replace(log_data=log),
+                jax.tree.map(lambda x: x[0], ctl.out),
+            )
+
+        return jax.lax.scan(body, state, inputs)
+
+    def _step_many(state, inputs, alive, quorum=None, trim=None):
+        return _step_many_j(state, inputs, alive,
+                            default_quorum if quorum is None else quorum,
+                            default_trim if trim is None else trim)
+
     vvote = jax.vmap(
         functools.partial(core_step.vote_step, cfg),
         in_axes=(0, None, None, 0, None, None),
@@ -161,8 +194,8 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
             image,
         )
 
-    return LocalEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn,
-                          _init_from)
+    return LocalEngineFns(_init, _step, _step_many, _vote, _read,
+                          _read_offset, _resync_fn, _init_from)
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +316,38 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
                        default_quorum if quorum is None else quorum,
                        default_trim if trim is None else trim)
 
+    # Chained rounds (see the local binding's _step_many_j for the
+    # rationale): scan INSIDE shard_map, so one dispatch commits K
+    # complete quorum rounds with all collectives on the mesh.
+    def step_many_body(state, inputs, rep, alive, quorum, trim):
+        def body(st_block, inp):
+            new_st, out = step_body(st_block, inp, rep, alive, quorum, trim)
+            return new_st, out
+
+        return jax.lax.scan(body, state, inputs)
+
+    in_specs_k = jax.tree.map(
+        lambda s: P(*((None,) + tuple(s))), in_specs,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+    smapped_step_many = _shard_map(
+        step_many_body,
+        mesh=mesh,
+        in_specs=(st_specs, in_specs_k, P("replica"), P("part", None),
+                  P("part"), P("part")),
+        out_specs=(st_specs, StepOutput(P(), P(), P(), P())),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_many_j(state, inputs, alive, quorum, trim):
+        return smapped_step_many(state, inputs, rep_ids, _norm_alive(alive),
+                                 quorum, trim)
+
+    def _step_many(state, inputs, alive, quorum=None, trim=None):
+        return _step_many_j(state, inputs, alive,
+                            default_quorum if quorum is None else quorum,
+                            default_trim if trim is None else trim)
+
     # ---- vote -------------------------------------------------------------
     def vote_body(state, cand, cand_term, rep, alive, quorum):
         st = _squeeze(state)
@@ -397,5 +462,5 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     def _init():
         return _place(init_state(cfg))
 
-    return SpmdEngineFns(_init, _step, _vote, _read, _read_offset, _resync_fn,
-                         _place, mesh)
+    return SpmdEngineFns(_init, _step, _step_many, _vote, _read,
+                         _read_offset, _resync_fn, _place, mesh)

@@ -155,6 +155,12 @@ class LockstepController:
             lambda: self._inner.step(state, inp, alive, quorum, trim),
         )
 
+    def step_many(self, state, inputs, alive, quorum=None, trim=None):
+        return self._call(
+            "step_many", [inputs, alive, quorum, trim],
+            lambda: self._inner.step_many(state, inputs, alive, quorum, trim),
+        )
+
     def vote(self, state, cand, cand_term, alive, quorum=None):
         return self._call(
             "vote", [cand, cand_term, alive, quorum],
@@ -255,6 +261,12 @@ class LockstepWorker:
 
             self._state, _ = fns.step(self._state, StepInput(*inp_t),
                                       alive, quorum, trim)
+        elif method == "step_many":
+            inp_t, alive, quorum, trim = args
+            from ripplemq_tpu.core.state import StepInput
+
+            self._state, _ = fns.step_many(self._state, StepInput(*inp_t),
+                                           alive, quorum, trim)
         elif method == "vote":
             cand, cand_term, alive, quorum = args
             self._state, _, _ = fns.vote(self._state, cand, cand_term,
