@@ -495,24 +495,42 @@ class DataPlane:
         watermark. Returns None if nothing is indexed at-or-after offset
         (caller falls through to the ring)."""
         SB = self.cfg.slot_bytes
-        entry = self.log_index.find(slot, offset)
-        floor = self.log_index.floor(slot)
-        if floor is not None and offset < floor:
-            # Below the bounded index's floor: records may exist in the
-            # store that fell out of the index — only a scan can tell.
-            scanned = self._scan_store_for(slot, offset)
-            if scanned is not None:
-                entry = scanned
-        if entry is None:
+        for _ in range(4):  # bounded GC-race retries (one per deleted seg)
+            entry = self.log_index.find(slot, offset)
+            floor = self.log_index.floor(slot)
+            if floor is not None and offset < floor:
+                # Below the bounded index's floor: records may exist in
+                # the store that fell out of the index — only a scan can
+                # tell.
+                scanned = self._scan_store_for(slot, offset)
+                if scanned is not None:
+                    entry = scanned
+            if entry is None:
+                return None
+            base, nrows, locator = entry
+            eff = max(offset, base)  # jump to the earliest retained record
+            row = eff - base
+            k = min(nrows - row, self.cfg.read_batch)
+            if k <= 0:
+                return None
+            try:
+                data = self.store.read_payload(locator, row * SB, k * SB)
+            except FileNotFoundError:
+                # Store GC deleted the backing segment between lookup and
+                # read: drop its stale entries (this also clears the scan
+                # cache) and redo the FULL lookup, including the
+                # below-floor scan path. Other OSErrors (truncation or
+                # corruption of a RETAINED segment) must surface, not be
+                # mistaken for deliberate deletion.
+                seg = locator[0] if isinstance(locator, tuple) else None
+                if seg is None:
+                    raise
+                self.drop_index_segments({seg})
+                continue
+            offset = eff
+            break
+        else:
             return None
-        base, nrows, locator = entry
-        if offset < base:
-            offset = base  # jumped to the earliest retained record
-        row = offset - base
-        k = min(nrows - row, self.cfg.read_batch)
-        if k <= 0:
-            return None
-        data = self.store.read_payload(locator, row * SB, k * SB)
         rows = np.frombuffer(data, np.uint8).reshape(k, SB)
         lens = np.asarray(row_lens(rows))  # one header decoder (core.state)
         with_pos = decode_entries_with_pos(rows, lens, k)
@@ -535,6 +553,18 @@ class DataPlane:
                     np.int32(consumer_slot),
                 )
             )
+
+    def drop_index_segments(self, seg_indices: set[int]) -> None:
+        """Store GC deleted these segments: prune their entries from the
+        retention indexes (reads below the remaining floor jump forward
+        to the earliest retained record)."""
+        if self.log_index is None or not seg_indices:
+            return
+        self.log_index.prune(
+            lambda loc: isinstance(loc, tuple) and loc[0] in seg_indices
+        )
+        with self._lock:
+            self._scan_index = None
 
     def _scan_store_for(
         self, slot: int, offset: int

@@ -142,6 +142,7 @@ class BrokerServer:
             self._round_store = SegmentStore(
                 self._store_dir, erasure=True,
                 segment_bytes=config.segment_bytes,
+                retention_bytes=config.store_retention_bytes,
             )
         else:
             from ripplemq_tpu.storage.memstore import MemoryRoundStore
@@ -482,6 +483,15 @@ class BrokerServer:
                     return {"ok": True, "data": f.read()}
             except OSError:
                 return {"ok": False, "error": "not_found"}
+        if t == "shard.drop":
+            name = str(req["name"])
+            if not valid_shard_name(name):
+                return {"ok": False, "error": f"bad shard name {name!r}"}
+            try:
+                os.remove(os.path.join(d, name))
+            except OSError:
+                pass  # already gone: drop is idempotent
+            return {"ok": True}
         return {"ok": False, "error": f"unknown shard op {t!r}"}
 
     def _refill_shards_from_peers(self) -> None:
@@ -540,7 +550,12 @@ class BrokerServer:
     def _seed_pushed_shards(self) -> None:
         """One-time (per boot) sync of the pushed-set with what peers
         already hold, so a restart does not re-transfer the whole sealed
-        history."""
+        history. Peer-held shards for segments below our persisted GC
+        floor are stale (the drop may have been missed across a
+        restart): ask those peers to drop them instead."""
+        from ripplemq_tpu.storage.segment import gc_floor
+
+        floor = gc_floor(self._store_dir)
         for b in self.config.brokers:
             if b.broker_id == self.broker_id:
                 continue
@@ -552,8 +567,64 @@ class BrokerServer:
                 )
             except RpcError:
                 continue  # unreachable: worst case a redundant re-push
-            if resp.get("ok"):
-                self._pushed_shards.update(resp.get("shards", []))
+            if not resp.get("ok"):
+                continue
+            for name in resp.get("shards", []):
+                stem = name.rpartition(".shard")[0]
+                if len(stem) >= 16 and stem[8:16].isdigit() \
+                        and int(stem[8:16]) < floor:
+                    try:
+                        self.client.call(
+                            b.address,
+                            {"type": "shard.drop",
+                             "owner": self.broker_id, "name": name},
+                            timeout=2.0,
+                        )
+                    except RpcError:
+                        pass
+                else:
+                    self._pushed_shards.add(name)
+
+    def _gc_duty(self) -> None:
+        """Size-capped store retention: delete the oldest sealed
+        segments past store_retention_bytes, prune the controller's
+        retention indexes, and tell the peers holding those segments'
+        distributed shards to drop their copies."""
+        gc = getattr(self._round_store, "gc", None)
+        if gc is None:
+            return
+        deleted = gc()
+        if not deleted:
+            return
+        log.info("broker %d: store GC deleted segments %s",
+                 self.broker_id, deleted)
+        if self.dataplane is not None:
+            self.dataplane.drop_index_segments(set(deleted))
+        # Peer copies of the deleted segments' shards are now garbage.
+        stems = {f"segment-{i:08d}.log" for i in deleted}
+        gone = {
+            n for n in self._pushed_shards
+            if n.rpartition(".shard")[0] in stems
+        }
+        self._pushed_shards -= gone
+        # Broadcast drops to every eligible peer: the push target
+        # rotation (including bad-target skips) means we cannot know
+        # which peer holds a given shard, and drop is idempotent+cheap.
+        roster = [b.broker_id for b in self.config.brokers]
+        for name in gone:
+            for target in roster:
+                if (target == self.broker_id
+                        or target in self._bad_shard_targets):
+                    continue
+                try:
+                    self.client.call(
+                        self._addr_of(target),
+                        {"type": "shard.drop", "owner": self.broker_id,
+                         "name": name},
+                        timeout=2.0,
+                    )
+                except RpcError:
+                    pass  # best-effort: peer copies are derived data
 
     def _shard_duty(self) -> None:
         """Push not-yet-distributed local shard files to their designated
@@ -572,8 +643,12 @@ class BrokerServer:
         if protect is not None:
             protect()  # traffic-independent encode trigger (see method)
         if not self._shard_push_seeded:
+            # Seed BEFORE the first GC pass: drops for already-GC'd
+            # segments are computed from the pushed-set, which must
+            # reflect what peers actually hold.
             self._shard_push_seeded = True
             self._seed_pushed_shards()
+        self._gc_duty()
         self._last_shard_push = now
         import os
 

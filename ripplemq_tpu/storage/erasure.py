@@ -212,14 +212,16 @@ def shard_file_names(store_dir: str) -> list[str]:
 
 def valid_shard_name(name: str) -> bool:
     """Guard for wire-supplied shard file names (path-traversal safety +
-    shape check before anything touches the filesystem)."""
+    exact shape check — segment-XXXXXXXX.log.shardN — before anything
+    touches the filesystem or parses the index digits)."""
     stem, _, suffix = name.rpartition(".shard")
     return (
-        bool(stem)
+        len(stem) == 20
         and suffix.isdigit()
         and int(suffix) < K + M
         and stem.startswith("segment-")
         and stem.endswith(".log")
+        and stem[8:16].isdigit()
         and "/" not in name
         and "\\" not in name
         and ".." not in name
@@ -245,7 +247,12 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
     # present-but-corrupt segment whose local shards were also lost is
     # exactly as dead as a missing one, and only peer shards can save it
     # (a present-and-healthy file costs at most K redundant fetches —
-    # repair validates health before rewriting anything).
+    # repair validates health before rewriting anything). Segments below
+    # the persisted GC floor were deleted deliberately — never refill
+    # them.
+    from ripplemq_tpu.storage.segment import gc_floor
+
+    floor = gc_floor(store_dir)
     remote: dict[str, list[tuple[str, str]]] = {}  # seg -> [(peer, fname)]
     for peer, list_fn in list_fns:
         try:
@@ -256,6 +263,8 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
             if not valid_shard_name(fname):
                 continue
             stem = fname.rpartition(".shard")[0]
+            if int(stem[8:16]) < floor:
+                continue
             remote.setdefault(stem, []).append((peer, fname))
     refilled = []
     rs_dir = _rs_dir(store_dir)
@@ -301,15 +310,19 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
 
 
 def segment_index_gaps(store_dir: str) -> bool:
-    """True when the store's segment numbering has holes (indices start
-    at 0 and rotate contiguously, so a hole means a sealed segment FILE
-    was lost) — the cheap local evidence that gates boot-time peer
-    refill."""
+    """True when the store's segment numbering has holes (indices rotate
+    contiguously, so a hole means a sealed segment FILE was lost) — the
+    cheap local evidence that gates boot-time peer refill. Indices below
+    the persisted GC floor were deleted deliberately and are not
+    holes."""
+    from ripplemq_tpu.storage.segment import gc_floor
+
     names = _segment_names(store_dir)
     if not names:
         return False
     indices = {int(n[8:16]) for n in names}
-    return indices != set(range(max(indices) + 1))
+    floor = gc_floor(store_dir)
+    return indices != set(range(floor, max(indices) + 1))
 
 
 def repair_store(store_dir: str, **kw) -> list[str]:

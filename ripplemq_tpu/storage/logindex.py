@@ -59,6 +59,20 @@ class LogIndex:
                 del bases[: len(bases) - self._max]
                 del entries[: len(entries) - self._max]
 
+    def prune(self, drop) -> int:
+        """Drop entries whose locator matches `drop(locator)` (store GC
+        deleted their backing records). Returns the number dropped."""
+        removed = 0
+        with self._lock:
+            for slot in list(self._entries):
+                entries = self._entries[slot]
+                keep = [e for e in entries if not drop(e[2])]
+                if len(keep) != len(entries):
+                    removed += len(entries) - len(keep)
+                    self._entries[slot] = keep
+                    self._bases[slot] = [e[0] for e in keep]
+        return removed
+
     def floor(self, slot: int) -> Optional[int]:
         """Lowest indexed base for `slot` (None if nothing indexed).
         Offsets below this may still exist in the store — only a store

@@ -101,6 +101,18 @@ class CorruptStoreError(Exception):
     """CRC/framing failure in the middle of the store (not a torn tail)."""
 
 
+def list_segment_files(directory: str) -> list[str]:
+    """Sorted segment file names in a store directory (the one place the
+    naming scheme is interpreted on the Python side; the native scanner
+    mirrors it in segstore.cpp list_segments)."""
+    if not os.path.isdir(directory):
+        return []
+    return sorted(
+        f for f in os.listdir(directory)
+        if f.startswith("segment-") and f.endswith(".log")
+    )
+
+
 class SegmentStore:
     """Writer. `use_native=None` auto-selects the C++ library.
 
@@ -114,10 +126,16 @@ class SegmentStore:
 
     def __init__(self, directory: str, segment_bytes: int = 64 << 20,
                  use_native: Optional[bool] = None,
-                 erasure: bool = False) -> None:
+                 erasure: bool = False,
+                 retention_bytes: Optional[int] = None) -> None:
         self.directory = directory
         self.segment_bytes = segment_bytes
         self.erasure = erasure
+        # Size-capped disk retention: gc() deletes the OLDEST sealed
+        # segments (and their local shards) while the sealed total
+        # exceeds this. None = unlimited (the default; the reference
+        # grows without bound too — in JVM heap).
+        self.retention_bytes = retention_bytes
         self._erasure_thread: Optional[threading.Thread] = None
         self._erasure_check_t = 0.0
         self.erasure_errors: list[str] = []
@@ -144,10 +162,7 @@ class SegmentStore:
         return os.path.join(self.directory, f"segment-{index:08d}.log")
 
     def _next_index(self) -> int:
-        existing = sorted(
-            f for f in os.listdir(self.directory)
-            if f.startswith("segment-") and f.endswith(".log")
-        )
+        existing = list_segment_files(self.directory)
         if not existing:
             return 0
         return int(existing[-1][8:16]) + 1
@@ -231,6 +246,51 @@ class SegmentStore:
             self.erasure_errors.append(f"{type(e).__name__}: {e}")
             del self.erasure_errors[:-20]
 
+    def gc(self) -> list[int]:
+        """Delete the oldest sealed segments while their total size
+        exceeds retention_bytes; returns the deleted segment INDICES.
+        Records in deleted segments are gone — consumers below the new
+        floor jump forward to the earliest retained record (the
+        documented earliest-reset semantics); callers must prune any
+        (segment, offset) indexes they hold (DataPlane.drop_index_segments).
+        The persisted gc floor (`gc_floor` file) distinguishes deliberate
+        head-of-store deletion from disk loss, so boot-time peer-shard
+        refill is not triggered by GC gaps."""
+        if self.retention_bytes is None:
+            return []
+        with self._lock:
+            sealed = list_segment_files(self.directory)[:-1]
+            sizes = {
+                n: os.path.getsize(os.path.join(self.directory, n))
+                for n in sealed
+            }
+            total = sum(sizes.values())
+            deleted: list[int] = []
+            for n in sealed:
+                if total <= self.retention_bytes:
+                    break
+                idx = int(n[8:16])
+                os.remove(os.path.join(self.directory, n))
+                rs_dir = os.path.join(self.directory, "rs")
+                if os.path.isdir(rs_dir):
+                    for f in os.listdir(rs_dir):
+                        if f.startswith(n + ".shard"):
+                            try:
+                                os.remove(os.path.join(rs_dir, f))
+                            except OSError:
+                                pass
+                total -= sizes[n]
+                deleted.append(idx)
+            if deleted:
+                floor = max(deleted) + 1
+                tmp = os.path.join(self.directory, "gc_floor.tmp")
+                with open(tmp, "w") as f:
+                    f.write(str(floor))
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, os.path.join(self.directory, "gc_floor"))
+            return deleted
+
     def protect_async(self) -> None:
         """Kick the background sealed-segment encoder. Duty loops call
         this periodically: flush() also kicks it, but flushes stop with
@@ -303,6 +363,18 @@ class SegmentStore:
                 self._erasure_worker()
 
 
+def gc_floor(directory: str) -> int:
+    """Lowest segment index deliberately retained after GC (0 if the
+    store was never GC'd). Segments below this were DELETED on purpose,
+    not lost — disaster tooling (erasure.segment_index_gaps, peer-shard
+    refill) must not try to resurrect them."""
+    try:
+        with open(os.path.join(directory, "gc_floor")) as f:
+            return int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
 def scan_store(
     directory: str, use_native: Optional[bool] = None
 ) -> Iterator[tuple[int, int, int, bytes]]:
@@ -357,12 +429,7 @@ def _scan_python_indexed(directory: str):
     """Python framing walk yielding (segment_index, payload_offset,
     (type, slot, base, payload)) — same torn-tail/corruption contract as
     scan_store."""
-    if not os.path.isdir(directory):
-        return
-    files = sorted(
-        f for f in os.listdir(directory)
-        if f.startswith("segment-") and f.endswith(".log")
-    )
+    files = list_segment_files(directory)
     for fi, name in enumerate(files):
         last_file = fi + 1 == len(files)
         seg_idx = int(name[8:16])
