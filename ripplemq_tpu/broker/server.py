@@ -118,6 +118,7 @@ class BrokerServer:
         self._owns_store = dataplane is None
         self._pushed_shards: set[str] = set()
         self._bad_shard_targets: set[int] = set()
+        self._pending_shard_drops: list[tuple[int, str]] = []
         self._shard_push_seeded = False
         self._last_shard_push = 0.0
         if dataplane is not None:
@@ -553,7 +554,8 @@ class BrokerServer:
         history. Peer-held shards for segments below our persisted GC
         floor are stale (the drop may have been missed across a
         restart): ask those peers to drop them instead."""
-        from ripplemq_tpu.storage.segment import gc_floor
+        from ripplemq_tpu.storage.erasure import valid_shard_name
+        from ripplemq_tpu.storage.segment import gc_floor, segment_index
 
         floor = gc_floor(self._store_dir)
         for b in self.config.brokers:
@@ -570,9 +572,9 @@ class BrokerServer:
             if not resp.get("ok"):
                 continue
             for name in resp.get("shards", []):
-                stem = name.rpartition(".shard")[0]
-                if len(stem) >= 16 and stem[8:16].isdigit() \
-                        and int(stem[8:16]) < floor:
+                if not valid_shard_name(name):
+                    continue
+                if segment_index(name.rpartition(".shard")[0]) < floor:
                     try:
                         self.client.call(
                             b.address,
@@ -601,30 +603,40 @@ class BrokerServer:
         if self.dataplane is not None:
             self.dataplane.drop_index_segments(set(deleted))
         # Peer copies of the deleted segments' shards are now garbage.
-        stems = {f"segment-{i:08d}.log" for i in deleted}
+        from ripplemq_tpu.storage.segment import segment_name
+
+        stems = {segment_name(i) for i in deleted}
         gone = {
             n for n in self._pushed_shards
             if n.rpartition(".shard")[0] in stems
         }
         self._pushed_shards -= gone
-        # Broadcast drops to every eligible peer: the push target
-        # rotation (including bad-target skips) means we cannot know
-        # which peer holds a given shard, and drop is idempotent+cheap.
-        roster = [b.broker_id for b in self.config.brokers]
+        # Queue drops for every eligible peer: the push target rotation
+        # (including bad-target skips) means we cannot know which peer
+        # holds a given shard, and drop is idempotent+cheap — but a big
+        # GC can queue hundreds, so the shared duty loop drains them a
+        # few per tick (_drain_shard_drops) instead of stalling failover
+        # duties behind sequential RPC timeouts.
         for name in gone:
-            for target in roster:
-                if (target == self.broker_id
-                        or target in self._bad_shard_targets):
+            for b in self.config.brokers:
+                if (b.broker_id == self.broker_id
+                        or b.broker_id in self._bad_shard_targets):
                     continue
-                try:
-                    self.client.call(
-                        self._addr_of(target),
-                        {"type": "shard.drop", "owner": self.broker_id,
-                         "name": name},
-                        timeout=2.0,
-                    )
-                except RpcError:
-                    pass  # best-effort: peer copies are derived data
+                self._pending_shard_drops.append((b.broker_id, name))
+
+    def _drain_shard_drops(self, budget: int = 4) -> None:
+        while budget > 0 and self._pending_shard_drops:
+            target, name = self._pending_shard_drops.pop(0)
+            budget -= 1
+            try:
+                self.client.call(
+                    self._addr_of(target),
+                    {"type": "shard.drop", "owner": self.broker_id,
+                     "name": name},
+                    timeout=2.0,
+                )
+            except RpcError:
+                pass  # best-effort: peer copies are derived data
 
     def _shard_duty(self) -> None:
         """Push not-yet-distributed local shard files to their designated
@@ -649,6 +661,7 @@ class BrokerServer:
             self._shard_push_seeded = True
             self._seed_pushed_shards()
         self._gc_duty()
+        self._drain_shard_drops()
         self._last_shard_push = now
         import os
 

@@ -325,12 +325,33 @@ def read_batch(
     at least the host's trim watermark — ring rows below trim may have
     been reclaimed (the host serves those from the segment store).
     """
+    return read_batch_at(
+        cfg, state.log_data[None], state.commit[None], jnp.int32(0),
+        partition, offset,
+    )
+
+
+def read_batch_at(
+    cfg: EngineConfig,
+    log_data: jax.Array,   # uint8 [R, P, S+B, SB] — FULL log, no copy
+    commit: jax.Array,     # int32 [R, P]
+    replica: jax.Array,    # int32 scalar
+    partition: jax.Array,  # int32 scalar
+    offset: jax.Array,     # int32 scalar — absolute storage offset
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """read_batch addressing the full multi-replica log with dynamic
+    slices — NO whole-replica gather. This matters under vmap (batched
+    reads, ops-level: engine read_many): `tree.map(x[replica])` per query
+    would materialize a [P, S, SB] copy of the log PER QUERY; here each
+    query moves exactly 2xRB rows."""
     RB, S = cfg.read_batch, cfg.slots
     SP = S + cfg.max_batch  # physical rows incl. wrap margin
+    R = log_data.shape[0]
+    replica = jnp.clip(replica, 0, R - 1)
     partition = jnp.clip(partition, 0, cfg.partitions - 1)
-    commit = state.commit[partition]
+    com = lax.dynamic_slice(commit, (replica, partition), (1, 1))[0, 0]
     start = jnp.maximum(offset, 0)
-    count = jnp.clip(commit - start, 0, RB)
+    count = jnp.clip(com - start, 0, RB)
     pos = start % S
     # Window A: physical [pos, pos+RB). dynamic_slice clamps the start so
     # the window fits; compensate by slicing at a clamped start and
@@ -338,16 +359,16 @@ def read_batch(
     sl_start = jnp.clip(pos, 0, SP - RB)
     shift = pos - sl_start
     rows_a = lax.dynamic_slice(
-        state.log_data,
-        (partition, sl_start, 0),
-        (1, RB, cfg.slot_bytes),
-    )[0]
+        log_data,
+        (replica, partition, sl_start, 0),
+        (1, 1, RB, cfg.slot_bytes),
+    )[0, 0]
     rows_a = jnp.roll(rows_a, -shift, axis=0)
     # Window B: ring head [0, RB) — serves row i when pos + i wraps past
     # the ring end (margin rows are never live; see core.state).
     rows_b = lax.dynamic_slice(
-        state.log_data, (partition, 0, 0), (1, RB, cfg.slot_bytes)
-    )[0]
+        log_data, (replica, partition, 0, 0), (1, 1, RB, cfg.slot_bytes)
+    )[0, 0]
     wrap_at = S - pos  # first window-index served from the ring head
     rows_b = jnp.roll(rows_b, wrap_at, axis=0)  # b[i] = head[i - wrap_at]
     i = jnp.arange(RB, dtype=jnp.int32)

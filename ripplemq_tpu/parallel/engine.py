@@ -41,6 +41,7 @@ class LocalEngineFns(NamedTuple):
     step_many: Callable[..., tuple[ReplicaState, StepOutput]]  # chained rounds
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
+    read_many: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]  # batched
     read_offset: Callable[..., jax.Array]
     resync: Callable[..., ReplicaState]
     init_from: Callable[[ReplicaState], ReplicaState]  # single-replica image -> [R] state
@@ -52,6 +53,7 @@ class SpmdEngineFns(NamedTuple):
     step_many: Callable[..., tuple[ReplicaState, StepOutput]]
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
+    read_many: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_offset: Callable[..., jax.Array]
     resync: Callable[..., ReplicaState]
     init_from: Callable[[ReplicaState], ReplicaState]
@@ -175,6 +177,21 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
         return core_step.read_batch(cfg, one, partition, offset)
 
     @jax.jit
+    def _read_many(state, replicas, partitions, offsets):
+        # Batched committed reads: Q independent (replica, partition,
+        # offset) queries in ONE dispatch — the consume-side mirror of
+        # append batching (each read dispatch costs a full host<->device
+        # round trip, which dominates when many consumers poll). Queries
+        # address the full log via read_batch_at: each moves only its
+        # own window, never a whole-replica slice.
+        def one(rep, part, off):
+            return core_step.read_batch_at(
+                cfg, state.log_data, state.commit, rep, part, off
+            )
+
+        return jax.vmap(one)(replicas, partitions, offsets)
+
+    @jax.jit
     def _read_offset(state, replica, partition, consumer_slot):
         replica = jnp.clip(replica, 0, R - 1)
         one = jax.tree.map(lambda x: x[replica], state)
@@ -195,7 +212,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
         )
 
     return LocalEngineFns(_init, _step, _step_many, _vote, _read,
-                          _read_offset, _resync_fn, _init_from)
+                          _read_many, _read_offset, _resync_fn, _init_from)
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +420,45 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         partition = jnp.clip(partition, 0, cfg.partitions - 1)
         return smapped_read(state, rep_ids, replica, partition, offset)
 
+    # Batched reads: Q queries, ONE dispatch, one psum for the whole
+    # batch (the consume-side mirror of append batching).
+    def read_many_body(state, rep, replicas, partitions, offsets):
+        st = _squeeze(state)
+        my_rep = rep[0]
+        my_shard = jax.lax.axis_index("part")
+
+        def one(replica, partition, offset):
+            shard = partition // local_P
+            local_idx = partition % local_P
+            data, lens, count = core_step.read_batch(cfg, st, local_idx,
+                                                     offset)
+            sel = (my_rep == replica) & (my_shard == shard)
+            return (
+                jnp.where(sel, data, 0),
+                jnp.where(sel, lens, 0),
+                jnp.where(sel, count, jnp.int32(0)),
+            )
+
+        data, lens, count = jax.vmap(one)(replicas, partitions, offsets)
+        data = jax.lax.psum(data, ("replica", "part"))
+        lens = jax.lax.psum(lens, ("replica", "part"))
+        count = jax.lax.psum(count, ("replica", "part"))
+        return data, lens, count
+
+    smapped_read_many = _shard_map(
+        read_many_body,
+        mesh=mesh,
+        in_specs=(st_specs, P("replica"), P(), P(), P()),
+        out_specs=(P(), P(), P()),
+    )
+
+    @jax.jit
+    def _read_many(state, replicas, partitions, offsets):
+        replicas = jnp.clip(replicas, 0, R - 1)
+        partitions = jnp.clip(partitions, 0, cfg.partitions - 1)
+        return smapped_read_many(state, rep_ids, replicas, partitions,
+                                 offsets)
+
     def read_off_body(state, rep, replica, partition, consumer_slot):
         st = _squeeze(state)
         shard = partition // local_P
@@ -463,4 +519,4 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         return _place(init_state(cfg))
 
     return SpmdEngineFns(_init, _step, _step_many, _vote, _read,
-                         _read_offset, _resync_fn, _place, mesh)
+                         _read_many, _read_offset, _resync_fn, _place, mesh)

@@ -173,6 +173,13 @@ class LockstepController:
             lambda: self._inner.read(state, replica, partition, offset),
         )
 
+    def read_many(self, state, replicas, partitions, offsets):
+        return self._call(
+            "read_many", [replicas, partitions, offsets],
+            lambda: self._inner.read_many(state, replicas, partitions,
+                                          offsets),
+        )
+
     def read_offset(self, state, replica, partition, consumer_slot):
         return self._call(
             "read_offset", [replica, partition, consumer_slot],
@@ -274,6 +281,9 @@ class LockstepWorker:
         elif method == "read":
             replica, partition, offset = args
             fns.read(self._state, replica, partition, offset)
+        elif method == "read_many":
+            replicas, partitions, offsets = args
+            fns.read_many(self._state, replicas, partitions, offsets)
         elif method == "read_offset":
             replica, partition, cslot = args
             fns.read_offset(self._state, replica, partition, cslot)

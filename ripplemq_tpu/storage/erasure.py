@@ -250,7 +250,7 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
     # repair validates health before rewriting anything). Segments below
     # the persisted GC floor were deleted deliberately — never refill
     # them.
-    from ripplemq_tpu.storage.segment import gc_floor
+    from ripplemq_tpu.storage.segment import gc_floor, segment_index
 
     floor = gc_floor(store_dir)
     remote: dict[str, list[tuple[str, str]]] = {}  # seg -> [(peer, fname)]
@@ -263,7 +263,7 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
             if not valid_shard_name(fname):
                 continue
             stem = fname.rpartition(".shard")[0]
-            if int(stem[8:16]) < floor:
+            if segment_index(stem) < floor:
                 continue
             remote.setdefault(stem, []).append((peer, fname))
     refilled = []

@@ -102,15 +102,25 @@ class CorruptStoreError(Exception):
 
 
 def list_segment_files(directory: str) -> list[str]:
-    """Sorted segment file names in a store directory (the one place the
-    naming scheme is interpreted on the Python side; the native scanner
-    mirrors it in segstore.cpp list_segments)."""
+    """Sorted segment file names in a store directory (with
+    segment_index/segment_name below, the one place the naming scheme is
+    interpreted on the Python side; the native scanner mirrors it in
+    segstore.cpp list_segments)."""
     if not os.path.isdir(directory):
         return []
     return sorted(
         f for f in os.listdir(directory)
         if f.startswith("segment-") and f.endswith(".log")
     )
+
+
+def segment_index(name: str) -> int:
+    """segment-XXXXXXXX.log (or a derived shard name's stem) → index."""
+    return int(name[8:16])
+
+
+def segment_name(index: int) -> str:
+    return f"segment-{index:08d}.log"
 
 
 class SegmentStore:

@@ -159,6 +159,58 @@ def test_recovery_after_gc(tmp_path):
         store2.close()
 
 
+def test_read_terminates_when_all_of_a_slots_history_is_gcd(tmp_path):
+    """A wrapped-then-idle partition whose records all lived in
+    GC'd segments (other partitions' traffic rotated them out) must
+    earliest-reset a lagging consumer to the trim watermark — not spin
+    forever between the empty store index and the trimmed ring."""
+    import threading
+
+    cfg = small_cfg(partitions=2, slots=32, max_batch=8)
+    d = str(tmp_path / "s")
+    store = SegmentStore(d, segment_bytes=4096, retention_bytes=8192)
+    dp = DataPlane(cfg, mode="local", store=store)
+    dp.start()
+    try:
+        dp.set_leader(0, 0, 1)
+        dp.set_leader(1, 0, 1)
+        sent0 = []
+        for i in range(96):  # slot 0 wraps (trim > 0), then goes idle
+            m = b"s0-%03d" % i
+            sent0.append(m)
+            dp.submit_append(0, [m]).result(timeout=30)
+        assert int(dp.trim[0]) > 0
+        for i in range(400):  # slot 1 seals enough segments for GC
+            dp.submit_append(1, [b"s1-%03d" % i + b"x" * 12]).result(timeout=30)
+        deleted = store.gc()
+        assert deleted
+        dp.drop_index_segments(set(deleted))
+        assert dp.log_index.floor(0) is None  # slot 0's records all gone
+
+        result: list = []
+
+        def reader():
+            got, offset = [], 0
+            while True:
+                g, nxt = dp.read(0, offset, replica=0)
+                if nxt == offset:
+                    break
+                got.extend(g)
+                offset = nxt
+            result.append(got)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "read() never terminated"
+        got = result[0]
+        # Earliest-reset to the ring: a contiguous suffix of slot 0.
+        assert got and got == sent0[sent0.index(got[0]):]
+    finally:
+        dp.stop()
+        store.close()
+
+
 def test_retention_config_validation():
     from ripplemq_tpu.metadata.models import BrokerInfo, Topic
     from ripplemq_tpu.metadata.cluster_config import ClusterConfig
