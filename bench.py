@@ -161,7 +161,19 @@ def _run_latency(cfg, submitters: int = 16,
     try:
         for p in range(cfg.partitions):
             dp.set_leader(p, 0, 1)
-        dp.submit_append(0, [PAYLOAD]).result(timeout=60)  # compile + warm
+        # Warm every program the measured run will hit: the single round
+        # at active-set buckets 8 and 32 (16 concurrent submitters
+        # coalesce into 9-16 active slots -> bucket 32) and the chained
+        # round (deep one-slot backlog). A mid-run compile would show up
+        # as a multi-second p999 outlier that is one-time, not
+        # steady-state.
+        dp.submit_append(0, [PAYLOAD]).result(timeout=120)      # A=8 single
+        warm = [dp.submit_append(p, [PAYLOAD]) for p in range(12)]
+        for f in warm:
+            f.result(timeout=120)                               # A=32 single
+        warm = [dp.submit_append(0, [PAYLOAD]) for _ in range(40)]
+        for f in warm:
+            f.result(timeout=120)                               # A=8 chain
         lats: list[float] = []
 
         def worker(tid: int) -> None:

@@ -236,6 +236,7 @@ class DataPlane:
         # of one slot (device-ordered). 1 disables chaining.
         self.chain_depth = max(1, chain_depth)
         self._zero_round = None  # lazy pad template (chain dispatches)
+        self._dummy = None       # lazy entries placeholder (see _drain)
         # Read coalescer: device reads queue here and drain as ONE
         # read_many dispatch of up to read_q queries — the consume-side
         # mirror of append batching. No artificial wait: while one batch
@@ -778,27 +779,44 @@ class DataPlane:
             trim = self.trim.astype(np.int32)
             if len(rounds) > 1:
                 # Pad to exactly chain_depth rounds (all-zero rounds
-                # carry no work and commit nothing) so only TWO programs
-                # ever compile: the single round and the full chain.
+                # carry no work and commit nothing) so chain programs
+                # compile once per active-set bucket, not per length.
                 # Zero tensors are a shared cached template (np.stack
                 # below copies them out; nothing ever writes them), and
                 # the leader/term snapshot happens HERE, under the lock,
                 # consistent with the chain's real rounds.
                 zero = self._zero_round_template()
-                pad_inp = StepInput(*zero, leader=self.leader.copy(),
+                pad_inp = StepInput(self._dummy_entries(), *zero,
+                                    leader=self.leader.copy(),
                                     term=self.term.copy())
                 while len(rounds) < self.chain_depth:
                     rounds.append((
-                        pad_inp, {"appends": {}, "offsets": {}, "bases": {}}
+                        pad_inp,
+                        {"appends": {}, "offsets": {}, "bases": {},
+                         "entries": {}, "counts": {}},
                     ))
+        chain = [r[1] for r in rounds]
+        # Compact active-set arrays: one [A, B, SB] block stack + global
+        # slot ids per round (A = shared bucket over the chain so the
+        # stacked shape is uniform; -1 pads). This is the ONLY bulk
+        # device input — a sparse round ships A/P of the dense bytes.
+        B, SB = cfg.max_batch, cfg.slot_bytes
+        A = self._active_bucket(max(len(rc["entries"]) for rc in chain))
+        ec = np.zeros((len(chain), A, B, SB), np.uint8)
+        ids = np.full((len(chain), A), -1, np.int32)
+        for k, rc in enumerate(chain):
+            for a, (slot, block) in enumerate(sorted(rc["entries"].items())):
+                ec[k, a] = block
+                ids[k, a] = slot
         if len(rounds) == 1:
-            inp, _ = rounds[0]
+            inp = rounds[0][0]
+            entries_c, slot_ids = ec[0], ids[0]
         else:
             inp = StepInput(*[
                 np.stack([np.asarray(getattr(r[0], f)) for r in rounds])
                 for f in StepInput._fields
             ])
-        chain = [r[1] for r in rounds]
+            entries_c, slot_ids = ec, ids
         # Top-level unions drive busy bookkeeping and whole-dispatch
         # failure paths (_fail_round, shadow-dirty marking).
         union_a: dict[int, list] = {}
@@ -809,24 +827,41 @@ class DataPlane:
             for slot, toff in rc["offsets"].items():
                 union_o.setdefault(slot, []).extend(toff)
         return inp, {"chain": chain, "appends": union_a, "offsets": union_o,
+                     "entries_c": entries_c, "slot_ids": slot_ids,
                      "alive": alive, "quorum": quorum, "trim": trim}
 
     def _zero_round_template(self):
-        """Shared all-zero (entries, counts, off_slots, off_vals,
-        off_counts) arrays for chain padding — read-only by contract
-        (np.stack copies them into the dispatch tensor)."""
+        """Shared all-zero (counts, off_slots, off_vals, off_counts)
+        arrays for chain padding — read-only by contract (np.stack
+        copies them into the dispatch tensor)."""
         if self._zero_round is None:
             cfg = self.cfg
-            P, B, SB, U = (cfg.partitions, cfg.max_batch, cfg.slot_bytes,
-                           cfg.max_offset_updates)
+            P, U = cfg.partitions, cfg.max_offset_updates
             self._zero_round = (
-                np.zeros((P, B, SB), np.uint8),
                 np.zeros((P,), np.int32),
                 np.zeros((P, U), np.int32),
                 np.zeros((P, U), np.int32),
                 np.zeros((P,), np.int32),
             )
         return self._zero_round
+
+    def _dummy_entries(self) -> np.ndarray:
+        """The StepInput entries placeholder: the control phase never
+        reads entries, and the real rows travel compacted (active-set;
+        see _drain). Shaped [P, 1, 1] so the spmd binding can shard its
+        leading axis like the dense field it replaces."""
+        if self._dummy is None:
+            self._dummy = np.zeros((self.cfg.partitions, 1, 1), np.uint8)
+        return self._dummy
+
+    def _active_bucket(self, n: int) -> int:
+        """Smallest active-set capacity bucket >= n (8, 32, 128, ... up
+        to P): rounds compile once per bucket, not once per active
+        count."""
+        a = 8
+        while a < n:
+            a *= 4
+        return max(1, min(a, self.cfg.partitions))
 
     def _build_round_locked(self, pred_end: dict[int, int]):
         """Build ONE round from the queues (caller holds self._lock).
@@ -835,7 +870,10 @@ class DataPlane:
         (StepInput, round_ctx) or None if nothing drainable remains."""
         cfg = self.cfg
         P, B, SB, U = cfg.partitions, cfg.max_batch, cfg.slot_bytes, cfg.max_offset_updates
-        entries = np.zeros((P, B, SB), np.uint8)
+        # Active-set rounds: packed [B, SB] blocks per appending slot
+        # (compact device input + the bytes the resolver persists); the
+        # StepInput ships only a tiny dummy in the entries field.
+        blocks: dict[int, np.ndarray] = {}
         counts = np.zeros((P,), np.int32)
         off_slots = np.zeros((P, U), np.int32)
         off_vals = np.zeros((P, U), np.int32)
@@ -898,7 +936,7 @@ class DataPlane:
                 batch.extend(pend.payloads)
                 fill += n
             if taken:
-                entries[slot] = pack_rows(cfg, batch, int(self.term[slot]))
+                blocks[slot] = pack_rows(cfg, batch, int(self.term[slot]))
                 counts[slot] = fill
                 round_appends[slot] = taken
                 round_bases[slot] = end
@@ -910,7 +948,7 @@ class DataPlane:
                 # the term; decode skips them) so the next round
                 # starts the lap at ring position 0.
                 pad = S - end % S  # < B here (head <= B did not fit)
-                entries[slot] = pack_rows(cfg, [], int(self.term[slot]))
+                blocks[slot] = pack_rows(cfg, [], int(self.term[slot]))
                 counts[slot] = pad
                 round_appends[slot] = []
                 round_bases[slot] = end
@@ -939,7 +977,7 @@ class DataPlane:
         if not round_appends and not round_offsets:
             return None
         inp = StepInput(
-            entries=entries,
+            entries=self._dummy_entries(),
             counts=counts,
             off_slots=off_slots,
             off_vals=off_vals,
@@ -948,7 +986,8 @@ class DataPlane:
             term=self.term.copy(),
         )
         return inp, {"appends": round_appends, "offsets": round_offsets,
-                     "bases": round_bases}
+                     "bases": round_bases, "entries": blocks,
+                     "counts": {s: int(counts[s]) for s in blocks}}
 
     def _run(self) -> None:
         """Step thread: drain → dispatch → hand off to the resolver."""
@@ -978,13 +1017,15 @@ class DataPlane:
                 inp, ctx = work
                 with self._device_lock:
                     if len(ctx["chain"]) == 1:
-                        self._state, out = self.fns.step(
-                            self._state, inp, ctx["alive"], ctx["quorum"],
+                        self._state, out = self.fns.step_sparse(
+                            self._state, inp, ctx["entries_c"],
+                            ctx["slot_ids"], ctx["alive"], ctx["quorum"],
                             ctx["trim"],
                         )
                     else:
-                        self._state, out = self.fns.step_many(
-                            self._state, inp, ctx["alive"], ctx["quorum"],
+                        self._state, out = self.fns.step_many_sparse(
+                            self._state, inp, ctx["entries_c"],
+                            ctx["slot_ids"], ctx["alive"], ctx["quorum"],
                             ctx["trim"],
                         )
                 self.rounds += sum(
@@ -1044,9 +1085,6 @@ class DataPlane:
             if committed.ndim == 1:
                 committed = committed[None]  # single round as a 1-chain
             chain = ctx["chain"]
-            counts = np.asarray(inp.counts)
-            if counts.ndim == 1:
-                counts = counts[None]
             # Advance the absolute-log-end shadow for every committed
             # append FIRST (the device already advanced; a failure in the
             # fallible work below must not leave the shadow behind).
@@ -1055,8 +1093,9 @@ class DataPlane:
             with self._lock:
                 for k, rc in enumerate(chain):
                     for slot in rc["appends"]:
-                        if committed[k, slot] and counts[k, slot] > 0:
-                            adv = -(-int(counts[k, slot]) // ALIGN) * ALIGN
+                        n = rc["counts"].get(slot, 0)
+                        if committed[k, slot] and n > 0:
+                            adv = -(-n // ALIGN) * ALIGN
                             self._log_end[slot] = rc["bases"][slot] + adv
                     for slot, taken_off in rc["offsets"].items():
                         if committed[k, slot]:
@@ -1065,13 +1104,7 @@ class DataPlane:
                                     self._offsets_shadow[slot, cs] = off
             records = []
             for k, rc in enumerate(chain):
-                inp_k = (
-                    inp if len(chain) == 1
-                    else StepInput(*(np.asarray(leaf)[k] for leaf in inp))
-                )
-                records.extend(self._round_records(
-                    inp_k, rc, rc["bases"], committed[k]
-                ))
+                records.extend(self._round_records(rc, committed[k]))
             self._persist_round(records)
             if self.replicate_fn is not None and records:
                 self.replicate_fn(records)
@@ -1104,21 +1137,22 @@ class DataPlane:
                 self._busy_a -= ctx["appends"].keys()
                 self._busy_o -= ctx["offsets"].keys()
 
-    def _round_records(self, inp: StepInput, ctx, base: dict, committed
+    def _round_records(self, rc: dict, committed
                        ) -> list[tuple[int, int, int, bytes]]:
-        """This round's committed writes as store/replication records.
-        `base` maps append slot -> the round's base offset (drain-time
-        shadow)."""
+        """One round's committed writes as store/replication records —
+        built from the round ctx's host-side copies (the packed blocks
+        the drain shipped to the device, plus counts and bases)."""
         records: list[tuple[int, int, int, bytes]] = []
-        entries = np.asarray(inp.entries)
-        counts = np.asarray(inp.counts)
-        for slot in ctx["appends"]:
-            if not committed[slot] or counts[slot] == 0:
+        for slot in rc["appends"]:
+            n = rc["counts"].get(slot, 0)
+            if not committed[slot] or n == 0:
                 continue
-            adv = int(-(-int(counts[slot]) // ALIGN) * ALIGN)
-            payload = entries[slot, :adv].tobytes()
-            records.append((REC_APPEND, int(slot), int(base[slot]), payload))
-        for slot, taken_off in ctx["offsets"].items():
+            adv = int(-(-n // ALIGN) * ALIGN)
+            payload = rc["entries"][slot][:adv].tobytes()
+            records.append(
+                (REC_APPEND, int(slot), int(rc["bases"][slot]), payload)
+            )
+        for slot, taken_off in rc["offsets"].items():
             if not committed[slot]:
                 continue
             pairs = [p for pend in taken_off for p in pend.payloads]

@@ -49,62 +49,14 @@ def _pick_k(P: int, target: int = 8) -> int:
     return max(1, k)
 
 
-def _kernel(K: int, BA: int, base_ref, dw_ref, entries_ref, log_in, log_out, sems):
-    r = pl.program_id(0)
-    c = pl.program_id(1)
-
-    def copy(k, p):
-        b = base_ref[p] // ALIGN  # block-row offset; exact by contract
-        return pltpu.make_async_copy(
-            entries_ref.at[k],
-            log_out.at[r, p, pl.ds(b, BA), :, :],
-            sems.at[k],
-        )
-
-    for k in range(K):  # static unroll; K is small
-        p = c * K + k
-
-        @pl.when(dw_ref[r, p] != 0)
-        def _(k=k, p=p):
-            copy(k, p).start()
-
-    for k in range(K):
-        p = c * K + k
-
-        @pl.when(dw_ref[r, p] != 0)
-        def _(k=k, p=p):
-            copy(k, p).wait()
-
-
 def _append_pallas(log_data, entries, base, do_write, *, interpret=False):
-    R, P, S, SB = log_data.shape
-    B = entries.shape[1]
-    BA = B // ALIGN
-    K = _pick_k(P)
-    log_v = log_data.reshape(R, P, S // ALIGN, ALIGN, SB)
-    entries_v = entries.reshape(P, BA, ALIGN, SB)
-    kernel = functools.partial(_kernel, K, BA)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # base, do_write
-        grid=(R, P // K),
-        in_specs=[
-            pl.BlockSpec((K, BA, ALIGN, SB), lambda r, c, *_: (c, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((K,))],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(log_v.shape, log_v.dtype),
-        # Alias the log operand in place. Indices count the pallas_call's
-        # positional inputs INCLUDING the scalar-prefetch args (base=0,
-        # do_write=1, entries=2, log=3).
-        input_output_aliases={3: 0},
+    """Dense write = the active-set kernel with every partition listed
+    (ids = arange(P)); one kernel to maintain."""
+    P = log_data.shape[1]
+    return _append_active_pallas(
+        log_data, entries, jnp.arange(P, dtype=jnp.int32), base, do_write,
         interpret=interpret,
-    )(base, do_write.astype(jnp.int32), entries_v, log_v)
-    return out.reshape(R, P, S, SB)
+    )
 
 
 def append_rows_xla(log_data, entries, base, do_write):
@@ -112,18 +64,120 @@ def append_rows_xla(log_data, entries, base, do_write):
 
     Handles both the per-replica shape ([P, S, SB] log with [P] do_write —
     the `replica_step` composition under vmap) and the full-cluster shape
-    ([R, P, S, SB] log with [R, P] do_write).
-    """
+    ([R, P, S, SB] log with [R, P] do_write). Dense = the active-set
+    scatter over every partition."""
+    P = log_data.shape[-3]
+    return append_rows_active_xla(
+        log_data, entries, jnp.arange(P, dtype=jnp.int32), base, do_write
+    )
+
+
+def _kernel_active(Ka: int, BA: int, ids_ref, base_ref, dw_ref, entries_ref,
+                   log_in, log_out, sems):
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+
+    def copy(k, a):
+        p = ids_ref[a]
+        b = base_ref[p] // ALIGN  # block-row offset; exact by contract
+        return pltpu.make_async_copy(
+            entries_ref.at[k],
+            log_out.at[r, p, pl.ds(b, BA), :, :],
+            sems.at[k],
+        )
+
+    def active(a):
+        # Padding entries carry id -1; `&` evaluates both operands, so
+        # the do_write gather must use a clamped index.
+        p = jnp.maximum(ids_ref[a], 0)
+        return (ids_ref[a] >= 0) & (dw_ref[r, p] != 0)
+
+    for k in range(Ka):  # static unroll; Ka is small
+        a = c * Ka + k
+
+        @pl.when(active(a))
+        def _(k=k, a=a):
+            copy(k, a).start()
+
+    for k in range(Ka):
+        a = c * Ka + k
+
+        @pl.when(active(a))
+        def _(k=k, a=a):
+            copy(k, a).wait()
+
+
+def _append_active_pallas(log_data, entries, slot_ids, base, do_write, *,
+                          interpret=False):
+    R, P, S, SB = log_data.shape
+    A, B = entries.shape[0], entries.shape[1]
+    BA = B // ALIGN
+    Ka = _pick_k(A)
+    log_v = log_data.reshape(R, P, S // ALIGN, ALIGN, SB)
+    entries_v = entries.reshape(A, BA, ALIGN, SB)
+    ids = jnp.where(slot_ids >= 0, jnp.clip(slot_ids, 0, P - 1), -1)
+    kernel = functools.partial(_kernel_active, Ka, BA)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # slot_ids, base, do_write
+        grid=(R, A // Ka),
+        in_specs=[
+            pl.BlockSpec((Ka, BA, ALIGN, SB), lambda r, c, *_: (c, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((Ka,))],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(log_v.shape, log_v.dtype),
+        # scalar-prefetch args count: ids=0, base=1, do_write=2,
+        # entries=3, log=4.
+        input_output_aliases={4: 0},
+        interpret=interpret,
+    )(ids, base, do_write.astype(jnp.int32), entries_v, log_v)
+    return out.reshape(R, P, S, SB)
+
+
+def append_rows_active_xla(log_data, entries, slot_ids, base, do_write):
+    """XLA fallback for the active-set write: scatter entries[a]'s rows
+    into partition slot_ids[a] (per replica)."""
     if log_data.ndim == 4:
-        return jax.vmap(append_rows_xla, in_axes=(0, None, None, 0))(
-            log_data, entries, base, do_write
+        return jax.vmap(append_rows_active_xla,
+                        in_axes=(0, None, None, None, 0))(
+            log_data, entries, slot_ids, base, do_write
         )
     P, S, SB = log_data.shape
-    B = entries.shape[1]
-    slot = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
-    pidx = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[:, None], (P, B))
-    idx = jnp.where(do_write[:, None], base[:, None] + slot, S)  # [P, B]
-    return log_data.at[pidx, idx].set(entries, mode="drop")
+    A, B = entries.shape[0], entries.shape[1]
+    ids = jnp.clip(slot_ids, 0, P - 1)
+    write = (slot_ids >= 0) & jnp.take(do_write, ids)          # [A]
+    rows = jnp.arange(B, dtype=jnp.int32)[None, :]             # [1, B]
+    ridx = jnp.where(write[:, None], jnp.take(base, ids)[:, None] + rows, S)
+    pidx = jnp.broadcast_to(ids[:, None], (A, B))
+    return log_data.at[pidx, ridx].set(entries, mode="drop")
+
+
+def append_rows_active(log_data, entries, slot_ids, base, do_write, *,
+                       use_pallas: bool | None = None,
+                       interpret: bool = False):
+    """Active-set write phase: entries [A, B, SB] carry only the A
+    partitions that have appends this round; slot_ids [A] maps each
+    block to its partition (-1 = padding). Identical semantics to
+    append_rows restricted to the listed partitions — the input
+    compaction is the point: a sparse round ships A x B x SB bytes
+    instead of P x B x SB (16-128x smaller under realistic fan-out),
+    and input transfer rides every dispatch.
+
+    Same contracts as append_rows (`base` physical, ALIGN-aligned;
+    full-B windows; do_write [R, P]); additionally each partition
+    appears at most once in slot_ids per round."""
+    SB = log_data.shape[-1]
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and SB % 128 == 0
+    if use_pallas or interpret:
+        return _append_active_pallas(log_data, entries, slot_ids, base,
+                                     do_write, interpret=interpret)
+    return append_rows_active_xla(log_data, entries, slot_ids, base, do_write)
 
 
 def append_rows(log_data, entries, base, do_write, *, use_pallas: bool | None = None,

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ripplemq_tpu.core.config import EngineConfig
 from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
 from ripplemq_tpu.core import step as core_step
-from ripplemq_tpu.ops.append import append_rows
+from ripplemq_tpu.ops.append import append_rows, append_rows_active
 
 try:  # jax>=0.6 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -39,6 +39,8 @@ class LocalEngineFns(NamedTuple):
     init: Callable[[], ReplicaState]          # -> state with leading [R] axis
     step: Callable[..., tuple[ReplicaState, StepOutput]]
     step_many: Callable[..., tuple[ReplicaState, StepOutput]]  # chained rounds
+    step_sparse: Callable[..., tuple[ReplicaState, StepOutput]]  # active-set
+    step_many_sparse: Callable[..., tuple[ReplicaState, StepOutput]]
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_many: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]  # batched
@@ -51,6 +53,8 @@ class SpmdEngineFns(NamedTuple):
     init: Callable[[], ReplicaState]
     step: Callable[..., tuple[ReplicaState, StepOutput]]
     step_many: Callable[..., tuple[ReplicaState, StepOutput]]
+    step_sparse: Callable[..., tuple[ReplicaState, StepOutput]]
+    step_many_sparse: Callable[..., tuple[ReplicaState, StepOutput]]
     vote: Callable[..., tuple[ReplicaState, jax.Array, jax.Array]]
     read: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
     read_many: Callable[..., tuple[jax.Array, jax.Array, jax.Array]]
@@ -154,6 +158,52 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
                             default_quorum if quorum is None else quorum,
                             default_trim if trim is None else trim)
 
+    # Active-set (sparse) variants: `inp.entries` is a tiny dummy (the
+    # control phase never reads it); the real rows arrive compacted as
+    # entries_c [A, B, SB] + slot_ids [A] (-1 pads) and land via the
+    # active-set write kernel. A sparse round ships A/P of the dense
+    # input bytes — and input transfer rides every dispatch (the broker
+    # batcher uses these; see ops.append.append_rows_active).
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_sparse_j(state, inp, entries_c, slot_ids, alive, quorum, trim):
+        new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum, trim)
+        log_data = append_rows_active(
+            state.log_data, entries_c, slot_ids,
+            ctl.out.base[0] % cfg.slots, ctl.do_write
+        )
+        new_state = new_state._replace(log_data=log_data)
+        return new_state, jax.tree.map(lambda x: x[0], ctl.out)
+
+    def _step_sparse(state, inp, entries_c, slot_ids, alive, quorum=None,
+                     trim=None):
+        return _step_sparse_j(state, inp, entries_c, slot_ids, alive,
+                              default_quorum if quorum is None else quorum,
+                              default_trim if trim is None else trim)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_many_sparse_j(state, inputs, entries_c, slot_ids, alive,
+                            quorum, trim):
+        def body(st, per_round):
+            inp, ec, ids = per_round
+            new_st, ctl = vctrl(st, inp, rep_idx, alive, quorum, trim)
+            log = append_rows_active(
+                st.log_data, ec, ids, ctl.out.base[0] % cfg.slots,
+                ctl.do_write
+            )
+            return (
+                new_st._replace(log_data=log),
+                jax.tree.map(lambda x: x[0], ctl.out),
+            )
+
+        return jax.lax.scan(body, state, (inputs, entries_c, slot_ids))
+
+    def _step_many_sparse(state, inputs, entries_c, slot_ids, alive,
+                          quorum=None, trim=None):
+        return _step_many_sparse_j(
+            state, inputs, entries_c, slot_ids, alive,
+            default_quorum if quorum is None else quorum,
+            default_trim if trim is None else trim)
+
     vvote = jax.vmap(
         functools.partial(core_step.vote_step, cfg),
         in_axes=(0, None, None, 0, None, None),
@@ -211,8 +261,9 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
             image,
         )
 
-    return LocalEngineFns(_init, _step, _step_many, _vote, _read,
-                          _read_many, _read_offset, _resync_fn, _init_from)
+    return LocalEngineFns(_init, _step, _step_many, _step_sparse,
+                          _step_many_sparse, _vote, _read, _read_many,
+                          _read_offset, _resync_fn, _init_from)
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +415,80 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         return _step_many_j(state, inputs, alive,
                             default_quorum if quorum is None else quorum,
                             default_trim if trim is None else trim)
+
+    # ---- sparse (active-set) steps ---------------------------------------
+    # entries_c/slot_ids are replicated to every shard; each shard maps
+    # the GLOBAL ids into its partition range (-1 = not mine/padding) and
+    # writes only its own blocks.
+    def _local_ids(ids):
+        my_shard = jax.lax.axis_index("part")
+        lo = my_shard * local_P
+        mine = (ids >= lo) & (ids < lo + local_P)
+        return jnp.where(mine, ids - lo, -1)
+
+    def step_sparse_body(state, inp, entries_c, slot_ids, rep, alive,
+                         quorum, trim):
+        st = _squeeze(state)
+        new_st, ctl = core_step.replica_control(
+            cfg, st, inp, rep[0], alive, quorum, trim
+        )
+        log_data = append_rows_active(
+            st.log_data[None], entries_c, _local_ids(slot_ids),
+            ctl.out.base % cfg.slots, ctl.do_write[None]
+        )
+        new_st = new_st._replace(log_data=log_data[0])
+        return _expand(new_st), _gather_part(ctl.out)
+
+    smapped_step_sparse = _shard_map(
+        step_sparse_body,
+        mesh=mesh,
+        in_specs=(st_specs, in_specs, P(None, None, None), P(None),
+                  P("replica"), P("part", None), P("part"), P("part")),
+        out_specs=(st_specs, StepOutput(P(), P(), P(), P())),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_sparse_j(state, inp, entries_c, slot_ids, alive, quorum, trim):
+        return smapped_step_sparse(state, inp, entries_c, slot_ids, rep_ids,
+                                   _norm_alive(alive), quorum, trim)
+
+    def _step_sparse(state, inp, entries_c, slot_ids, alive, quorum=None,
+                     trim=None):
+        return _step_sparse_j(state, inp, entries_c, slot_ids, alive,
+                              default_quorum if quorum is None else quorum,
+                              default_trim if trim is None else trim)
+
+    def step_many_sparse_body(state, inputs, entries_c, slot_ids, rep,
+                              alive, quorum, trim):
+        def body(st_block, per_round):
+            inp, ec, ids = per_round
+            return step_sparse_body(st_block, inp, ec, ids, rep, alive,
+                                    quorum, trim)
+
+        return jax.lax.scan(body, state, (inputs, entries_c, slot_ids))
+
+    smapped_step_many_sparse = _shard_map(
+        step_many_sparse_body,
+        mesh=mesh,
+        in_specs=(st_specs, in_specs_k, P(None, None, None, None),
+                  P(None, None), P("replica"), P("part", None), P("part"),
+                  P("part")),
+        out_specs=(st_specs, StepOutput(P(), P(), P(), P())),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def _step_many_sparse_j(state, inputs, entries_c, slot_ids, alive,
+                            quorum, trim):
+        return smapped_step_many_sparse(
+            state, inputs, entries_c, slot_ids, rep_ids,
+            _norm_alive(alive), quorum, trim)
+
+    def _step_many_sparse(state, inputs, entries_c, slot_ids, alive,
+                          quorum=None, trim=None):
+        return _step_many_sparse_j(
+            state, inputs, entries_c, slot_ids, alive,
+            default_quorum if quorum is None else quorum,
+            default_trim if trim is None else trim)
 
     # ---- vote -------------------------------------------------------------
     def vote_body(state, cand, cand_term, rep, alive, quorum):
@@ -518,5 +643,6 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     def _init():
         return _place(init_state(cfg))
 
-    return SpmdEngineFns(_init, _step, _step_many, _vote, _read,
-                         _read_many, _read_offset, _resync_fn, _place, mesh)
+    return SpmdEngineFns(_init, _step, _step_many, _step_sparse,
+                         _step_many_sparse, _vote, _read, _read_many,
+                         _read_offset, _resync_fn, _place, mesh)

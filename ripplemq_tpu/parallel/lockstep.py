@@ -161,6 +161,23 @@ class LockstepController:
             lambda: self._inner.step_many(state, inputs, alive, quorum, trim),
         )
 
+    def step_sparse(self, state, inp, entries_c, slot_ids, alive,
+                    quorum=None, trim=None):
+        return self._call(
+            "step_sparse", [inp, entries_c, slot_ids, alive, quorum, trim],
+            lambda: self._inner.step_sparse(state, inp, entries_c, slot_ids,
+                                            alive, quorum, trim),
+        )
+
+    def step_many_sparse(self, state, inputs, entries_c, slot_ids, alive,
+                         quorum=None, trim=None):
+        return self._call(
+            "step_many_sparse",
+            [inputs, entries_c, slot_ids, alive, quorum, trim],
+            lambda: self._inner.step_many_sparse(
+                state, inputs, entries_c, slot_ids, alive, quorum, trim),
+        )
+
     def vote(self, state, cand, cand_term, alive, quorum=None):
         return self._call(
             "vote", [cand, cand_term, alive, quorum],
@@ -274,6 +291,20 @@ class LockstepWorker:
 
             self._state, _ = fns.step_many(self._state, StepInput(*inp_t),
                                            alive, quorum, trim)
+        elif method == "step_sparse":
+            inp_t, entries_c, slot_ids, alive, quorum, trim = args
+            from ripplemq_tpu.core.state import StepInput
+
+            self._state, _ = fns.step_sparse(
+                self._state, StepInput(*inp_t), entries_c, slot_ids,
+                alive, quorum, trim)
+        elif method == "step_many_sparse":
+            inp_t, entries_c, slot_ids, alive, quorum, trim = args
+            from ripplemq_tpu.core.state import StepInput
+
+            self._state, _ = fns.step_many_sparse(
+                self._state, StepInput(*inp_t), entries_c, slot_ids,
+                alive, quorum, trim)
         elif method == "vote":
             cand, cand_term, alive, quorum = args
             self._state, _, _ = fns.vote(self._state, cand, cand_term,

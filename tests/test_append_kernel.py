@@ -94,3 +94,61 @@ def test_dispatcher_interpret_flag_routes_to_pallas():
     got = np.asarray(append_rows(log, entries, base, do_write, interpret=True))
     want = np.asarray(append_rows_xla(log, entries, base, do_write))
     np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------- active-set write
+
+def rand_sparse_case(rng, R=3, P=16, S=64, SB=128, B=16, A=8, actives=5):
+    """Dense case + its compact active-set form for the same partitions."""
+    log = rng.integers(0, 256, size=(R, P, S, SB), dtype=np.uint8)
+    entries = np.zeros((P, B, SB), np.uint8)
+    base = (
+        rng.integers(0, (S - B) // ALIGN + 1, size=(P,)) * ALIGN
+    ).astype(np.int32)
+    do_write = np.zeros((R, P), bool)
+    ids = np.full((A,), -1, np.int32)
+    entries_c = np.zeros((A, B, SB), np.uint8)
+    chosen = rng.choice(P, size=actives, replace=False)
+    for a, p in enumerate(chosen):
+        block = rng.integers(0, 256, size=(B, SB), dtype=np.uint8)
+        entries[p] = block
+        entries_c[a] = block
+        ids[a] = p
+        do_write[:, p] = rng.random(R) < 0.7
+    return log, entries, entries_c, ids, base, do_write
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_active_set_matches_dense_randomized(seed):
+    from ripplemq_tpu.ops.append import (
+        _append_active_pallas,
+        append_rows_active_xla,
+    )
+
+    rng = np.random.default_rng(seed)
+    log, entries, entries_c, ids, base, do_write = rand_sparse_case(rng)
+    dense = np.asarray(append_rows_xla(log.copy(), entries, base, do_write))
+    got_xla = np.asarray(
+        append_rows_active_xla(log.copy(), entries_c, ids, base, do_write)
+    )
+    got_pl = np.asarray(_append_active_pallas(
+        log.copy(), entries_c, ids, base, do_write, interpret=True
+    ))
+    np.testing.assert_array_equal(got_xla, dense)
+    np.testing.assert_array_equal(got_pl, dense)
+
+
+def test_active_set_all_padding_is_identity():
+    from ripplemq_tpu.ops.append import _append_active_pallas
+
+    rng = np.random.default_rng(7)
+    log, *_ = rand_sparse_case(rng)
+    A, B, SB = 8, 16, 128
+    got = np.asarray(_append_active_pallas(
+        log.copy(), np.zeros((A, B, SB), np.uint8),
+        np.full((A,), -1, np.int32),
+        np.zeros((log.shape[1],), np.int32),
+        np.ones((log.shape[0], log.shape[1]), bool),
+        interpret=True,
+    ))
+    np.testing.assert_array_equal(got, log)

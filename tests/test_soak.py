@@ -45,10 +45,13 @@ def cluster4():
         yield c
 
 
-def _produce(c, client, topic, pid, payload, dead=(), timeout=60.0):
+def _produce(c, client, topic, pid, payload, dead=(), timeout=60.0,
+             stop=None):
     deadline = time.time() + timeout
     last = None
     while time.time() < deadline:
+        if stop is not None and stop.is_set():
+            raise AssertionError("stopped")  # traffic wind-down: not acked
         for b in c.brokers.values():
             if b.broker_id in dead:
                 continue
@@ -128,7 +131,11 @@ def test_soak_ring_wrap_failover_zero_loss(cluster4):
         while not stop.is_set():
             payload = b"soak-%d-%04d" % (tid, i)
             try:
-                _produce(c, client, "t", tid % 2, payload, dead=dead)
+                # `stop` passed through: a produce mid-retry at wind-down
+                # must abort UNacked — a success landing after the
+                # verification drain would read as spurious loss.
+                _produce(c, client, "t", tid % 2, payload, dead=dead,
+                         stop=stop)
                 acked.append(payload)
             except AssertionError:
                 pass
@@ -167,6 +174,7 @@ def test_soak_ring_wrap_failover_zero_loss(cluster4):
     stop.set()
     for t in threads:
         t.join(timeout=30)
+        assert not t.is_alive(), "traffic thread still running at drain"
 
     # Zero committed-entry loss across wrap + failover, including the
     # store-served history below the promoted controller's trim.
