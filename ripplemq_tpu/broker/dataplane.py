@@ -33,7 +33,7 @@ import time
 
 from ripplemq_tpu.core.config import ALIGN, EngineConfig
 from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
-from ripplemq_tpu.core.state import ReplicaState, StepInput, init_state, row_lens
+from ripplemq_tpu.core.state import ReplicaState, StepInput, row_lens
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh
 from ripplemq_tpu.storage.segment import (

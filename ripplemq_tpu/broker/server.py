@@ -42,7 +42,6 @@ import threading
 import time
 from typing import Callable, Optional
 
-import numpy as np
 
 from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner

@@ -33,7 +33,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 _POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
 

@@ -18,7 +18,7 @@ Both produce bit-identical semantics (asserted in tests/test_spmd.py).
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -31,7 +31,7 @@ stream, exactly as for a single-host controller death.
 from __future__ import annotations
 
 import threading
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import itertools
 import socket
-import struct
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Optional
