@@ -104,13 +104,28 @@ def test_broker_death_heals_assignment_and_leadership(cluster5):
 
 
 def test_metadata_leader_death_reelects_and_heals(cluster5):
+    """Kill the metadata leader WHOEVER it is — including when it is also
+    the data-plane controller (round 2 skipped that double-role death;
+    controller failover now makes it survivable, so the test confronts
+    it: the stream standbys elect a new controller under a bumped epoch
+    while the metadata group re-elects)."""
     c = cluster5
     meta_leader = next(
         i for i, b in c.brokers.items()
         if b.runner.node.role == "leader"
     )
-    if meta_leader == c.config.controller:
-        pytest.skip("metadata leader landed on controller; covered elsewhere")
+    double_role = (
+        meta_leader
+        == next(iter(c.brokers.values())).manager.current_controller()
+    )
+    if double_role:
+        # Controller promotion needs the standby set to be caught up.
+        assert wait_until(
+            lambda: len(next(b for i, b in c.brokers.items()
+                             if i != meta_leader)
+                        .manager.current_standbys()) >= 1,
+            timeout=60,
+        ), "standby set never formed before double-role kill"
     c.net.set_down(c.brokers[meta_leader].addr)
     c.brokers[meta_leader].stop()
 
@@ -119,6 +134,16 @@ def test_metadata_leader_death_reelects_and_heals(cluster5):
         lambda: sum(1 for b in survivors if b.runner.node.role == "leader") == 1,
         timeout=60,
     )
+    if double_role:
+        # The data plane moved too: a live standby was promoted.
+        assert wait_until(
+            lambda: survivors[0].manager.current_controller() != meta_leader,
+            timeout=60,
+        ), "controller never moved off the dead double-role broker"
+        new_ctrl = survivors[0].manager.current_controller()
+        assert wait_until(
+            lambda: c.brokers[new_ctrl].dataplane is not None, timeout=60
+        ), "promoted controller never booted a data plane"
     # New metadata leader resumes assignment duty: victim leaves replica sets.
     def victim_gone():
         return all(
