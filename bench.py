@@ -17,7 +17,7 @@ What is measured (BASELINE.md metric: committed-appends/sec/chip on a
 5-replica partition, 1k-partition fan-out config; p99 ack alongside):
 
 - **TPU mode**: the production configuration — 1024 partitions × RF 5,
-  full 128-entry batches per partition per round, psum quorum commit —
+  full 256-entry batches per partition per round, psum quorum commit —
   dispatched as CHAINS of 8 complete quorum rounds per launch (the
   engine's step_many scan path, which the broker's burst drain uses for
   deep backlogs; dispatch latency is the fixed cost that dominates small
@@ -310,12 +310,14 @@ def _round_rtt(cfg, samples: int = 8) -> float:
 def main() -> None:
     from ripplemq_tpu.core.config import EngineConfig
 
-    # TPU mode: 1k partitions, RF 5, full 128-row batches, 8-round chains.
+    # TPU mode: 1k partitions, RF 5, full 256-row batches, 8-round chains
+    # (B swept: rounds are DMA-issue-bound, so bytes-per-DMA is nearly
+    # free throughput until ~B=256; B=512 regresses).
     tpu_cfg = EngineConfig(
-        partitions=1024, replicas=5, slots=8192, slot_bytes=128,
-        max_batch=128, read_batch=32, max_consumers=64, max_offset_updates=8,
+        partitions=1024, replicas=5, slots=12352, slot_bytes=128,
+        max_batch=256, read_batch=32, max_consumers=64, max_offset_updates=8,
     )
-    tpu_rate = _run_mode(tpu_cfg, batch_per_partition=128, rounds=48,
+    tpu_rate = _run_mode(tpu_cfg, batch_per_partition=256, rounds=48,
                          warmup=1, verify=True, chain=8)
 
     # Baseline mode: the reference's shape — 1 partition, RF 5, ONE entry
@@ -351,7 +353,7 @@ def main() -> None:
                 "unit": "appends/s",
                 "vs_baseline": round(tpu_rate / base_rate, 2),
                 "baseline_appends_per_sec": round(base_rate, 1),
-                "config": "P=1024 R=5 B=128 chain=8",
+                "config": "P=1024 R=5 B=256 chain=8",
                 "p50_ack_ms": round(lat["p50"], 3),
                 "p99_ack_ms": round(lat["p99"], 3),
                 "p999_ack_ms": round(lat["p999"], 3),

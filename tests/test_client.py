@@ -185,36 +185,28 @@ def test_not_leader_recovery_after_failover():
             # double-role case).
             off = producer.produce("fo", b"after", partition=0)
             assert off > 0  # storage offsets are ALIGN-padded per round
-            # Readback through a surviving leader proves both messages
-            # (committing after each read to page forward).
-            got = []
-            check = c.client("fo-check")
-            deadline = time.monotonic() + 60
-            while len(got) < 2 and time.monotonic() < deadline:
-                survivors = [b for i, b in c.brokers.items() if i != victim]
-                leader = survivors[0].manager.leader_of(("fo", 0))
-                if leader in (None, victim):
-                    time.sleep(0.05)
-                    continue
-                addr = c.brokers[leader].addr
-                resp = check.call(
-                    addr,
-                    {"type": "consume", "topic": "fo", "partition": 0,
-                     "consumer": "fo-check"},
-                    timeout=5.0,
-                )
-                if resp.get("ok") and resp["messages"]:
-                    got.extend(resp["messages"])
-                    check.call(
-                        addr,
-                        {"type": "offset.commit", "topic": "fo",
-                         "partition": 0, "consumer": "fo-check",
-                         "offset": resp["next_offset"]},
-                        timeout=5.0,
-                    )
-                else:
-                    time.sleep(0.05)
-            assert got == [b"before", b"after"], got
+            # Readback proves both messages — through the real consumer
+            # SDK (auto-commit paging, not_leader retries built in).
+            consumer = ConsumerClient(
+                [b.address for b in c.config.brokers],
+                "fo-check",
+                transport=c.client("fo-consumer"),
+                metadata_refresh_s=0.3,
+                retries=20,
+                retry_backoff_s=0.3,
+                rpc_timeout_s=10.0,
+            )
+            try:
+                got = []
+                deadline = time.monotonic() + 60
+                while len(got) < 2 and time.monotonic() < deadline:
+                    try:
+                        got.extend(consumer.consume("fo", partition=0))
+                    except Exception:
+                        time.sleep(0.2)
+                assert got == [b"before", b"after"], got
+            finally:
+                consumer.close()
         finally:
             producer.close()
 
