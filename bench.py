@@ -148,45 +148,6 @@ def _run_mode(cfg, batch_per_partition: int, rounds: int, warmup: int,
     return total / dt
 
 
-def _warm_round_programs(dp, buckets=(8, 32)) -> None:
-    """Compile the DataPlane's sparse single/chained round programs for
-    the given active-set buckets by dispatching no-op rounds (counts 0,
-    all-padding ids: nothing commits, state is semantically unchanged)."""
-    from ripplemq_tpu.core.state import StepInput
-
-    cfg = dp.cfg
-    P, B, SB, U = (cfg.partitions, cfg.max_batch, cfg.slot_bytes,
-                   cfg.max_offset_updates)
-    noop = StepInput(
-        entries=np.zeros((P, 1, 1), np.uint8),
-        counts=np.zeros((P,), np.int32),
-        off_slots=np.zeros((P, U), np.int32),
-        off_vals=np.zeros((P, U), np.int32),
-        off_counts=np.zeros((P,), np.int32),
-        leader=np.zeros((P,), np.int32),
-        term=np.zeros((P,), np.int32),
-    )
-    alive = np.ones((P, cfg.replicas), bool)
-    K = dp.chain_depth
-    stacked = StepInput(*[
-        np.broadcast_to(np.asarray(f), (K,) + np.asarray(f).shape).copy()
-        for f in noop
-    ])
-    for A in buckets:
-        A = min(A, P)
-        ec1 = np.zeros((A, B, SB), np.uint8)
-        ids1 = np.full((A,), -1, np.int32)
-        with dp._device_lock:
-            dp._state, _ = dp.fns.step_sparse(
-                dp._state, noop, ec1, ids1, alive
-            )
-            dp._state, _ = dp.fns.step_many_sparse(
-                dp._state, stacked,
-                np.zeros((K, A, B, SB), np.uint8),
-                np.full((K, A), -1, np.int32), alive,
-            )
-
-
 def _run_latency(cfg, submitters: int = 16,
                  per_thread: int = 250) -> dict[str, float]:
     """Submit→ack latency percentiles (ms) through the DataPlane batcher
@@ -201,12 +162,11 @@ def _run_latency(cfg, submitters: int = 16,
         for p in range(cfg.partitions):
             dp.set_leader(p, 0, 1)
         # Warm every program the measured run can hit (single + chained
-        # rounds at active-set buckets 8 and 32): compiled
-        # DETERMINISTICALLY by dispatching no-op rounds of those exact
-        # shapes straight through the engine — queue-coalescing races
+        # rounds at active-set buckets 8 and 32) via the same
+        # DataPlane.warm() brokers run at boot — queue-coalescing races
         # could otherwise skip a shape and charge its multi-second XLA
         # compile to the measured p999.
-        _warm_round_programs(dp, buckets=(8, 32))
+        dp.warm(buckets=(8, 32))
         dp.submit_append(0, [PAYLOAD]).result(timeout=120)  # host path warm
         lats: list[float] = []
 

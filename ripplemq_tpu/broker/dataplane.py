@@ -609,6 +609,70 @@ class DataPlane:
         with self._lock:
             return int(self._offsets_shadow[slot, consumer_slot])
 
+    def warm(self, buckets: tuple[int, ...] = (8, 32)) -> None:
+        """Compile the hot programs before traffic needs them: the sparse
+        single and chained rounds at the given active-set buckets, and
+        the batched read. Dispatches no-op rounds of those exact shapes
+        (counts 0, all-padding ids: nothing commits, state is
+        semantically unchanged). Safe concurrently with traffic (device
+        lock); brokers kick this in the background at boot so the first
+        produce doesn't pay the multi-second XLA compile."""
+        cfg = self.cfg
+        P, B, SB, U = (cfg.partitions, cfg.max_batch, cfg.slot_bytes,
+                       cfg.max_offset_updates)
+        noop = StepInput(
+            entries=self._dummy_entries(),
+            counts=np.zeros((P,), np.int32),
+            off_slots=np.zeros((P, U), np.int32),
+            off_vals=np.zeros((P, U), np.int32),
+            off_counts=np.zeros((P,), np.int32),
+            leader=np.zeros((P,), np.int32),
+            term=np.zeros((P,), np.int32),
+        )
+        alive = np.ones((P, cfg.replicas), bool)
+        K = self.chain_depth
+        stacked = StepInput(*[
+            np.broadcast_to(np.asarray(f), (K,) + np.asarray(f).shape).copy()
+            for f in noop
+        ])
+        for A in buckets:
+            A = max(1, min(A, P))
+            # One lock hold per dispatch: elections/traffic (takeover
+            # duty) interleave between the multi-second compiles instead
+            # of stalling behind a whole bucket's pair.
+            with self._device_lock:
+                self._state, _ = self.fns.step_sparse(
+                    self._state, noop, np.zeros((A, B, SB), np.uint8),
+                    np.full((A,), -1, np.int32), alive,
+                )
+            if K > 1:
+                with self._device_lock:
+                    self._state, _ = self.fns.step_many_sparse(
+                        self._state, stacked,
+                        np.zeros((K, A, B, SB), np.uint8),
+                        np.full((K, A), -1, np.int32), alive,
+                    )
+        with self._device_lock:
+            self.fns.read_many(
+                self._state, np.zeros((self.read_q,), np.int32),
+                np.zeros((self.read_q,), np.int32),
+                np.zeros((self.read_q,), np.int32),
+            )
+
+    def warm_async(self, buckets: tuple[int, ...] = (8, 32)) -> threading.Thread:
+        """warm() on a daemon thread (boot path); errors are logged, never
+        raised — warming is an optimization, not a correctness step."""
+        def run() -> None:
+            try:
+                self.warm(buckets)
+            except Exception as e:
+                log.warning("program warm-up failed: %s: %s",
+                            type(e).__name__, e)
+
+        t = threading.Thread(target=run, daemon=True, name="dataplane-warm")
+        t.start()
+        return t
+
     def _read_loop(self) -> None:
         """Read-coalescer thread: drain queued device reads as read_many
         batches of up to read_q queries (padded to a fixed Q so exactly

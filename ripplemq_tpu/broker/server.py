@@ -263,6 +263,7 @@ class BrokerServer:
         self.manager.attach_dataplane(dp)
         if self._started:
             dp.start()
+        dp.warm_async()  # compile hot programs before traffic needs them
 
     def _make_replicator(self):
         from ripplemq_tpu.broker.replication import RoundReplicator
