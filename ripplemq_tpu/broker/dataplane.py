@@ -287,8 +287,14 @@ class DataPlane:
         # before their next round (a resolve failed with the round's
         # outcome possibly unknown). Guarded by self._lock.
         self._shadow_dirty: set[int] = set()
-        # Host-side counters (exposed through the broker's admin.stats RPC).
+        # Host-side counters (exposed through the broker's admin.stats
+        # RPC). `rounds` counts quorum rounds; `dispatches` device
+        # launches (rounds/dispatches = chaining factor); the read pair
+        # measures the read coalescer's batching.
         self.rounds = 0
+        self.dispatches = 0
+        self.read_queries = 0
+        self.read_dispatches = 0
         self.committed_entries = 0
         self.step_errors = 0
 
@@ -636,6 +642,8 @@ class DataPlane:
             for f in noop
         ])
         for A in buckets:
+            if self._stop.is_set():
+                return  # fenced/stopped mid-warm: the programs are moot
             A = max(1, min(A, P))
             # One lock hold per dispatch: elections/traffic (takeover
             # duty) interleave between the multi-second compiles instead
@@ -645,13 +653,15 @@ class DataPlane:
                     self._state, noop, np.zeros((A, B, SB), np.uint8),
                     np.full((A,), -1, np.int32), alive,
                 )
-            if K > 1:
+            if K > 1 and not self._stop.is_set():
                 with self._device_lock:
                     self._state, _ = self.fns.step_many_sparse(
                         self._state, stacked,
                         np.zeros((K, A, B, SB), np.uint8),
                         np.full((K, A), -1, np.int32), alive,
                     )
+        if self._stop.is_set():
+            return
         with self._device_lock:
             self.fns.read_many(
                 self._state, np.zeros((self.read_q,), np.int32),
@@ -659,10 +669,16 @@ class DataPlane:
                 np.zeros((self.read_q,), np.int32),
             )
 
-    def warm_async(self, buckets: tuple[int, ...] = (8, 32)) -> threading.Thread:
+    def warm_async(self, buckets: tuple[int, ...] = (8, 32),
+                   delay_s: float = 0.0) -> threading.Thread:
         """warm() on a daemon thread (boot path); errors are logged, never
-        raised — warming is an optimization, not a correctness step."""
+        raised — warming is an optimization, not a correctness step.
+        `delay_s` defers the first compile so latency-critical boot work
+        (a promoted controller's first election pass) wins the device-
+        lock race; the thread exits early if the plane stops meanwhile."""
         def run() -> None:
+            if delay_s > 0 and self._stop.wait(timeout=delay_s):
+                return
             try:
                 self.warm(buckets)
             except Exception as e:
@@ -693,6 +709,8 @@ class DataPlane:
                     self._read_work.clear()
             if not batch:
                 continue
+            self.read_dispatches += 1
+            self.read_queries += len(batch)
             reps = np.zeros((Q,), np.int32)
             parts = np.zeros((Q,), np.int32)
             offs = np.zeros((Q,), np.int32)
@@ -1092,6 +1110,7 @@ class DataPlane:
                             ctx["slot_ids"], ctx["alive"], ctx["quorum"],
                             ctx["trim"],
                         )
+                self.dispatches += 1
                 self.rounds += sum(
                     1 for rc in ctx["chain"]
                     if rc["appends"] or rc["offsets"]

@@ -263,7 +263,12 @@ class BrokerServer:
         self.manager.attach_dataplane(dp)
         if self._started:
             dp.start()
-        dp.warm_async()  # compile hot programs before traffic needs them
+        # Compile hot programs before traffic needs them. On TAKEOVER
+        # (epoch > 0) the first election pass is the latency-critical
+        # device work — let it win the lock race before warming.
+        dp.warm_async(
+            delay_s=2.0 if self.manager.current_epoch() > 0 else 0.0
+        )
 
     def _make_replicator(self):
         from ripplemq_tpu.broker.replication import RoundReplicator
@@ -408,6 +413,9 @@ class BrokerServer:
             engine = {
                 "mode": self._engine_mode,
                 "rounds": dp.rounds,
+                "dispatches": dp.dispatches,
+                "read_queries": dp.read_queries,
+                "read_dispatches": dp.read_dispatches,
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
                 "partitions": dp.cfg.partitions,
