@@ -213,10 +213,14 @@ void* segscan_open(const char* dir) {
 
 // Returns payload length (>= 0) with header fields filled, -1 at end of
 // store, -2 on corruption in the middle of the store, -3 if buf is too
-// small (buflen receives the needed size via *base… no: returns -3 and
-// the caller retries with a bigger buffer of size *len_out).
-int segscan_next(void* h, int* type, int* slot, int* base,
-                 uint8_t* buf, int buflen, int* len_out) {
+// small (returns -3 and the caller retries with a bigger buffer of size
+// *len_out). The `_at` variant additionally reports the record's
+// LOCATOR — the segment's numeric index (parsed from its file name) and
+// the payload's byte offset within it — which the broker's retention
+// read path serves lagging consumers from (storage/logindex.py).
+int segscan_next_at(void* h, int* type, int* slot, int* base,
+                    uint8_t* buf, int buflen, int* len_out,
+                    int* seg_index, long* payload_off) {
   Scan* sc = static_cast<Scan*>(h);
   if (!sc || sc->corrupt) return -2;
   for (;;) {
@@ -251,12 +255,27 @@ int segscan_next(void* h, int* type, int* slot, int* base,
     }
     uint32_t len = get_u32(hdr + 13);
     uint32_t crc = get_u32(hdr + 17);
+    // A length beyond any record the writer can produce is corruption —
+    // and must be rejected BEFORE sizing reads with it: a signed compare
+    // against buflen would let len >= 2^31 skip the grow path and
+    // overrun the caller's buffer.
+    if (len > (1u << 30)) {
+      fclose(sc->f);
+      sc->f = nullptr;
+      if (last_file) {
+        sc->file_idx++;
+        return -1;  // torn tail garbage
+      }
+      sc->corrupt = true;
+      return -2;
+    }
     *len_out = (int)len;
-    if ((int)len > buflen) {
+    if (len > (uint32_t)buflen) {
       // rewind so the caller can retry with a larger buffer
       fseek(sc->f, -(long)kHeader, SEEK_CUR);
       return -3;
     }
+    long pos_after_header = ftell(sc->f);
     got = len ? fread(buf, 1, len, sc->f) : 0;
     if (got < len || crc32_of(buf, len) != crc) {
       fclose(sc->f);
@@ -271,8 +290,22 @@ int segscan_next(void* h, int* type, int* slot, int* base,
     *type = hdr[4];
     *slot = (int)get_u32(hdr + 5);
     *base = (int)get_u32(hdr + 9);
+    if (seg_index) {
+      const std::string& path = sc->files[sc->file_idx];
+      size_t p = path.rfind("segment-");
+      *seg_index = (p == std::string::npos)
+                       ? -1
+                       : atoi(path.substr(p + 8, 8).c_str());
+    }
+    if (payload_off) *payload_off = pos_after_header;
     return (int)len;
   }
+}
+
+int segscan_next(void* h, int* type, int* slot, int* base,
+                 uint8_t* buf, int buflen, int* len_out) {
+  return segscan_next_at(h, type, slot, base, buf, buflen, len_out,
+                         nullptr, nullptr);
 }
 
 void segscan_close(void* h) {

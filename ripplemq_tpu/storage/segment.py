@@ -87,6 +87,14 @@ def _load_native() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int),
             ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
         ]
+        lib.segscan_next_at.restype = ctypes.c_int
+        lib.segscan_next_at.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+        ]
         lib.segscan_close.restype = None
         lib.segscan_close.argtypes = [ctypes.c_void_p]
         _LIB = lib
@@ -326,12 +334,10 @@ class SegmentStore:
 
     def scan_indexed(self) -> Iterator[tuple[int, int, int, bytes, tuple[int, int]]]:
         """Like scan(), plus each record's locator (boot-time index build
-        for the retention read path). Python framing only — the native
-        scanner does not expose file positions and this runs once per
-        boot."""
-        for seg_idx, off, rec in _scan_python_indexed(self.directory):
-            rec_type, slot, base, payload = rec
-            yield rec_type, slot, base, payload, (seg_idx, off)
+        for the retention read path). Uses the native scanner's position-
+        reporting walk when available (the boot scan of a multi-GB store
+        is C-speed, not Python framing)."""
+        return scan_store_indexed(self.directory)
 
     def read_payload(self, locator: tuple[int, int], byte_start: int,
                      nbytes: int) -> bytes:
@@ -391,18 +397,32 @@ def scan_store(
     """Yield (type, slot, base, payload) records in write order. A torn
     tail record is silently dropped (crash contract); corruption anywhere
     else raises CorruptStoreError."""
+    for rec_type, slot, base, payload, _loc in scan_store_indexed(
+        directory, use_native
+    ):
+        yield rec_type, slot, base, payload
+
+
+def scan_store_indexed(
+    directory: str, use_native: Optional[bool] = None
+) -> Iterator[tuple[int, int, int, bytes, tuple[int, int]]]:
+    """Yield (type, slot, base, payload, (segment_index, payload_offset))
+    in write order — scan_store plus each record's locator. Same torn-
+    tail/corruption contract."""
     if not os.path.isdir(directory):
         return
     lib = _load_native() if use_native in (None, True) else None
     if use_native is True and lib is None:
         raise RuntimeError("native segstore requested but unavailable")
     if lib is not None:
-        yield from _scan_native(lib, directory)
+        yield from _scan_native_indexed(lib, directory)
     else:
-        yield from _scan_python(directory)
+        for seg_idx, off, rec in _scan_python_indexed(directory):
+            rec_type, slot, base, payload = rec
+            yield rec_type, slot, base, payload, (seg_idx, off)
 
 
-def _scan_native(lib, directory: str):
+def _scan_native_indexed(lib, directory: str):
     handle = lib.segscan_open(directory.encode())
     if not handle:
         return
@@ -410,13 +430,17 @@ def _scan_native(lib, directory: str):
     slot = ctypes.c_int()
     base = ctypes.c_int()
     need = ctypes.c_int()
+    seg = ctypes.c_int()
+    off = ctypes.c_long()
     buflen = 1 << 20
     buf = ctypes.create_string_buffer(buflen)
     try:
         while True:
-            rc = lib.segscan_next(handle, ctypes.byref(t), ctypes.byref(slot),
-                                  ctypes.byref(base), buf, buflen,
-                                  ctypes.byref(need))
+            rc = lib.segscan_next_at(
+                handle, ctypes.byref(t), ctypes.byref(slot),
+                ctypes.byref(base), buf, buflen, ctypes.byref(need),
+                ctypes.byref(seg), ctypes.byref(off),
+            )
             if rc == -3:  # grow the buffer and retry
                 buflen = max(buflen * 2, need.value)
                 buf = ctypes.create_string_buffer(buflen)
@@ -425,14 +449,12 @@ def _scan_native(lib, directory: str):
                 return
             if rc == -2:
                 raise CorruptStoreError(f"corrupt record in {directory}")
-            yield t.value, slot.value, base.value, buf.raw[:rc]
+            yield (t.value, slot.value, base.value, buf.raw[:rc],
+                   (seg.value, off.value))
     finally:
         lib.segscan_close(handle)
 
 
-def _scan_python(directory: str):
-    for _seg, _off, rec in _scan_python_indexed(directory):
-        yield rec
 
 
 def _scan_python_indexed(directory: str):
@@ -457,6 +479,12 @@ def _scan_python_indexed(directory: str):
                     if last_file:
                         return
                     raise CorruptStoreError(f"bad magic in {name}")
+                if length > (1 << 30):
+                    # Corrupt length field: reject BEFORE allocating a
+                    # read of that size (mirrors the native scanner).
+                    if last_file:
+                        return
+                    raise CorruptStoreError(f"absurd record length in {name}")
                 payload_off = f.tell()
                 payload = f.read(length)
                 if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
