@@ -88,9 +88,16 @@ struct Store {
 struct Scan {
   std::vector<std::string> files;
   size_t file_idx = 0;
+  int seg_no = -1;  // numeric index of the OPEN file (parsed once)
   FILE* f = nullptr;
   bool corrupt = false;
 };
+
+int parse_seg_no(const std::string& path) {
+  size_t p = path.rfind("segment-");
+  return (p == std::string::npos) ? -1
+                                  : atoi(path.substr(p + 8, 8).c_str());
+}
 
 int list_segments(const std::string& dir, std::vector<std::string>* out) {
   DIR* d = opendir(dir.c_str());
@@ -160,7 +167,10 @@ int segstore_append_at(void* h, int type, int slot, int base,
                        const uint8_t* data, int len,
                        int* out_seg, long* out_off) {
   Store* s = static_cast<Store*>(h);
-  if (!s || s->fd < 0 || len < 0) return -1;
+  // The scanners reject length fields above 1 GiB as corruption, so the
+  // writer must refuse them too — an acked-but-unreadable record would
+  // be silent data loss at recovery.
+  if (!s || s->fd < 0 || len < 0 || len > (1 << 30)) return -1;
   if (s->seg_size + (long)(kHeader + len) > s->segment_bytes && s->seg_size > 0) {
     close(s->fd);
     s->seg_index++;
@@ -231,6 +241,7 @@ int segscan_next_at(void* h, int* type, int* slot, int* base,
         sc->corrupt = true;
         return -2;
       }
+      sc->seg_no = parse_seg_no(sc->files[sc->file_idx]);
     }
     uint8_t hdr[kHeader];
     size_t got = fread(hdr, 1, kHeader, sc->f);
@@ -290,13 +301,7 @@ int segscan_next_at(void* h, int* type, int* slot, int* base,
     *type = hdr[4];
     *slot = (int)get_u32(hdr + 5);
     *base = (int)get_u32(hdr + 9);
-    if (seg_index) {
-      const std::string& path = sc->files[sc->file_idx];
-      size_t p = path.rfind("segment-");
-      *seg_index = (p == std::string::npos)
-                       ? -1
-                       : atoi(path.substr(p + 8, 8).c_str());
-    }
+    if (seg_index) *seg_index = sc->seg_no;
     if (payload_off) *payload_off = pos_after_header;
     return (int)len;
   }

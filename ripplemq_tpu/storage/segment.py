@@ -47,10 +47,8 @@ def _load_native() -> Optional[ctypes.CDLL]:
         src_dir = os.path.abspath(_NATIVE_DIR)
         so_path = os.path.join(src_dir, "libsegstore.so")
         src_path = os.path.join(src_dir, "segstore.cpp")
-        try:
-            if not os.path.exists(src_path):
-                return None
-            if not os.path.exists(so_path) or (
+        def compile_and_load(force: bool) -> ctypes.CDLL:
+            if force or not os.path.exists(so_path) or (
                 os.path.getmtime(so_path) < os.path.getmtime(src_path)
             ):
                 subprocess.run(
@@ -58,47 +56,66 @@ def _load_native() -> Optional[ctypes.CDLL]:
                      "-o", so_path, src_path],
                     check=True, capture_output=True, timeout=120,
                 )
-            lib = ctypes.CDLL(so_path)
-        except (OSError, subprocess.SubprocessError):
+            return ctypes.CDLL(so_path)
+
+        try:
+            if not os.path.exists(src_path):
+                return None
+            lib = compile_and_load(force=False)
+            try:
+                _bind(lib)
+            except AttributeError:
+                # A cached .so from older source can carry a fresher
+                # mtime (copied artifacts, clock skew) yet lack newer
+                # symbols: rebuild once from the checked-in source.
+                lib = compile_and_load(force=True)
+                _bind(lib)
+        except (OSError, subprocess.SubprocessError, AttributeError):
             return None
-        lib.segstore_open.restype = ctypes.c_void_p
-        lib.segstore_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
-        lib.segstore_append.restype = ctypes.c_int
-        lib.segstore_append.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.segstore_append_at.restype = ctypes.c_int
-        lib.segstore_append_at.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
-        ]
-        lib.segstore_flush.restype = ctypes.c_int
-        lib.segstore_flush.argtypes = [ctypes.c_void_p]
-        lib.segstore_close.restype = None
-        lib.segstore_close.argtypes = [ctypes.c_void_p]
-        lib.segscan_open.restype = ctypes.c_void_p
-        lib.segscan_open.argtypes = [ctypes.c_char_p]
-        lib.segscan_next.restype = ctypes.c_int
-        lib.segscan_next.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int),
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
-        ]
-        lib.segscan_next_at.restype = ctypes.c_int
-        lib.segscan_next_at.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int),
-            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
-        ]
-        lib.segscan_close.restype = None
-        lib.segscan_close.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return _LIB
+
+
+def _bind(lib) -> None:
+    """Declare every exported symbol's signature — inside the loader's
+    try so a stale library missing a symbol degrades to the Python path
+    instead of crashing boot."""
+    lib.segstore_open.restype = ctypes.c_void_p
+    lib.segstore_open.argtypes = [ctypes.c_char_p, ctypes.c_long]
+    lib.segstore_append.restype = ctypes.c_int
+    lib.segstore_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.segstore_append_at.restype = ctypes.c_int
+    lib.segstore_append_at.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.segstore_flush.restype = ctypes.c_int
+    lib.segstore_flush.argtypes = [ctypes.c_void_p]
+    lib.segstore_close.restype = None
+    lib.segstore_close.argtypes = [ctypes.c_void_p]
+    lib.segscan_open.restype = ctypes.c_void_p
+    lib.segscan_open.argtypes = [ctypes.c_char_p]
+    lib.segscan_next.restype = ctypes.c_int
+    lib.segscan_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.segscan_next_at.restype = ctypes.c_int
+    lib.segscan_next_at.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.segscan_close.restype = None
+    lib.segscan_close.argtypes = [ctypes.c_void_p]
 
 
 def native_available() -> bool:
@@ -195,6 +212,13 @@ class SegmentStore:
         """Append one framed record; returns its locator
         (segment_index, payload_byte_offset) — the position the retention
         read path (storage.logindex) serves lagging consumers from."""
+        if len(payload) > (1 << 30):
+            # The scanners reject length fields above 1 GiB as corruption;
+            # writing one would be an acked-but-unreadable record.
+            raise ValueError(
+                f"record payload of {len(payload)} bytes exceeds the "
+                f"1 GiB store record cap"
+            )
         with self._lock:
             if self._handle is not None:
                 seg = ctypes.c_int()
@@ -335,9 +359,13 @@ class SegmentStore:
     def scan_indexed(self) -> Iterator[tuple[int, int, int, bytes, tuple[int, int]]]:
         """Like scan(), plus each record's locator (boot-time index build
         for the retention read path). Uses the native scanner's position-
-        reporting walk when available (the boot scan of a multi-GB store
-        is C-speed, not Python framing)."""
-        return scan_store_indexed(self.directory)
+        reporting walk when this store runs natively (the boot scan of a
+        multi-GB store is C-speed, not Python framing); a store built
+        with use_native=False keeps its opt-out here too."""
+        return scan_store_indexed(
+            self.directory,
+            use_native=None if self._lib is not None else False,
+        )
 
     def read_payload(self, locator: tuple[int, int], byte_start: int,
                      nbytes: int) -> bytes:
@@ -449,8 +477,10 @@ def _scan_native_indexed(lib, directory: str):
                 return
             if rc == -2:
                 raise CorruptStoreError(f"corrupt record in {directory}")
-            yield (t.value, slot.value, base.value, buf.raw[:rc],
-                   (seg.value, off.value))
+            # string_at copies exactly rc bytes (buf.raw would first
+            # materialize the whole — possibly grown — buffer per record).
+            yield (t.value, slot.value, base.value,
+                   ctypes.string_at(buf, rc), (seg.value, off.value))
     finally:
         lib.segscan_close(handle)
 
