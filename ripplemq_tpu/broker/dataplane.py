@@ -51,6 +51,15 @@ class NotCommittedError(Exception):
     """The round(s) carrying this request never reached quorum."""
 
 
+class StoreReadRaceError(NotCommittedError):
+    """A store read kept colliding with concurrent segment GC. Transient:
+    the records exist (or existed); retry rather than treating the window
+    as absent — absence triggers an earliest-reset that would silently
+    skip retained rows. Subclasses NotCommittedError so the broker's
+    dispatch surfaces it as a retryable `not_committed` refusal, not an
+    internal error."""
+
+
 class PartitionFullError(NotCommittedError):
     """The partition's log has no room for the batch (backpressure).
 
@@ -145,6 +154,14 @@ class DataPlane:
         P0 = cfg.partitions
         self.trim = np.zeros((P0,), np.int64)
         self._log_end = np.zeros((P0,), np.int64)
+        # Persisted prefix per partition: rows below this are in the
+        # ROUND STORE (appended; flush may lag by flush_interval_s).
+        # Advanced by _persist_round only after the store append
+        # succeeded — NOT by the shadow-dirty device re-derivation,
+        # which can cover committed-but-never-persisted rounds after a
+        # persist failure. The drain-time trim raise clamps against
+        # THIS, so everything below trim is always store-servable.
+        self._persisted = np.zeros((P0,), np.int64)
         self.log_index = None
         self._scan_index = None  # lazy full-history index (_scan_store_for)
         if store is not None and hasattr(store, "scan_indexed"):
@@ -360,6 +377,20 @@ class DataPlane:
         with self._lock:
             self.quorum = quorum.copy()
 
+    def _adopt_lockstep_state(self, e: Exception) -> None:
+        """A LockstepController call failed AFTER its local launch ran:
+        the donated state buffers are gone, and the error carries their
+        replacement. Adopt it so the plane stays usable (the error still
+        propagates — the round fails loudly with the lockstep-break
+        diagnostic, not with confusing donated-buffer errors forever
+        after). Caller holds _device_lock."""
+        st = getattr(e, "lockstep_result", None)
+        if st is None:
+            return
+        # Engine results are (state, ...) tuples except resync/init_from,
+        # which return the state (a NamedTuple — itself a tuple) directly.
+        self._state = st if hasattr(st, "_fields") else st[0]
+
     def _fetch_state(self, field: str) -> np.ndarray:
         """Host copy of one state leaf. Under lockstep, the allgather is
         a broadcast engine call (every process must launch it); callers
@@ -499,11 +530,23 @@ class DataPlane:
         path is race-free)."""
         if not 0 <= slot < self.cfg.partitions:
             raise ValueError(f"partition slot {slot} out of range")
+        gc_races = 0
         while True:
             with self._lock:
                 trim = int(self.trim[slot])
             if offset < trim and self.log_index is not None:
-                got = self._read_store(slot, offset, max_msgs)
+                try:
+                    got = self._read_store(slot, offset, max_msgs)
+                except StoreReadRaceError:
+                    # Sustained GC churn: records exist but every lookup
+                    # lost the race. Retry (bounded) instead of treating
+                    # the window as absent — an earliest-reset here
+                    # would skip retained rows.
+                    gc_races += 1
+                    if gc_races > 50:
+                        raise
+                    time.sleep(0.001)
+                    continue
                 if got is not None:
                     return got
                 # Nothing persisted at-or-after `offset` (store GC can
@@ -590,7 +633,13 @@ class DataPlane:
             offset = eff
             break
         else:
-            return None
+            # Exhausted the per-call retry budget WITH a record found
+            # each time: that is GC churn, not absence — the caller must
+            # not earliest-reset over it.
+            raise StoreReadRaceError(
+                f"partition {slot} offset {offset}: store read lost the "
+                f"GC race 4 times"
+            )
         rows = np.frombuffer(data, np.uint8).reshape(k, SB)
         lens = np.asarray(row_lens(rows))  # one header decoder (core.state)
         with_pos = decode_entries_with_pos(rows, lens, k)
@@ -649,17 +698,25 @@ class DataPlane:
             # duty) interleave between the multi-second compiles instead
             # of stalling behind a whole bucket's pair.
             with self._device_lock:
-                self._state, _ = self.fns.step_sparse(
-                    self._state, noop, np.zeros((A, B, SB), np.uint8),
-                    np.full((A,), -1, np.int32), alive,
-                )
+                try:
+                    self._state, _ = self.fns.step_sparse(
+                        self._state, noop, np.zeros((A, B, SB), np.uint8),
+                        np.full((A,), -1, np.int32), alive,
+                    )
+                except Exception as e:
+                    self._adopt_lockstep_state(e)
+                    raise
             if K > 1 and not self._stop.is_set():
                 with self._device_lock:
-                    self._state, _ = self.fns.step_many_sparse(
-                        self._state, stacked,
-                        np.zeros((K, A, B, SB), np.uint8),
-                        np.full((K, A), -1, np.int32), alive,
-                    )
+                    try:
+                        self._state, _ = self.fns.step_many_sparse(
+                            self._state, stacked,
+                            np.zeros((K, A, B, SB), np.uint8),
+                            np.full((K, A), -1, np.int32), alive,
+                        )
+                    except Exception as e:
+                        self._adopt_lockstep_state(e)
+                        raise
         if self._stop.is_set():
             return
         with self._device_lock:
@@ -780,6 +837,31 @@ class DataPlane:
             entry = self._scan_index.find(slot, offset)
         return entry
 
+    def slot_detail(self, slots) -> dict[str, dict[str, int]]:
+        """Per-slot observability snapshot: the COMMIT leaf fetched from
+        the device (one fetch for all requested slots — not log_end
+        relabeled), plus the host log-end shadow and trim watermark read
+        together under the control lock so the host pair is mutually
+        consistent. Commit and the host pair are separate snapshots with
+        rounds possibly landing between them, so either may lead the
+        other by in-flight rounds — treat a small commit/log_end skew as
+        pipelining, not corruption."""
+        with self._device_lock:
+            commit = self._fetch_state("commit").max(axis=0)  # [P]
+        with self._lock:
+            ends = self._log_end.copy()
+            trim = self.trim.copy()
+        out = {}
+        for s in slots:
+            s = int(s)
+            if 0 <= s < self.cfg.partitions:
+                out[str(s)] = {
+                    "commit": int(commit[s]),
+                    "log_end": int(ends[s]),
+                    "trim": int(trim[s]),
+                }
+        return out
+
     def commit_index(self, slot: int) -> int:
         """Max commit index across replicas (the leader's view)."""
         with self._device_lock:
@@ -802,9 +884,13 @@ class DataPlane:
             alive = self.alive.copy()
             quorum = self.quorum.copy()
         with self._device_lock:
-            self._state, elected, votes = self.fns.vote(
-                self._state, cand, cterm, alive, quorum
-            )
+            try:
+                self._state, elected, votes = self.fns.vote(
+                    self._state, cand, cterm, alive, quorum
+                )
+            except Exception as e:
+                self._adopt_lockstep_state(e)
+                raise
             elected = np.asarray(elected)
         return {slot: bool(elected[slot]) for slot in candidates}
 
@@ -814,9 +900,13 @@ class DataPlane:
         mask = np.zeros((self.cfg.partitions,), bool)
         mask[list(partitions)] = True
         with self._device_lock:
-            self._state = self.fns.resync(
-                self._state, np.int32(src_slot), np.int32(dst_slot), mask
-            )
+            try:
+                self._state = self.fns.resync(
+                    self._state, np.int32(src_slot), np.int32(dst_slot), mask
+                )
+            except Exception as e:
+                self._adopt_lockstep_state(e)
+                raise
 
     # ---------------------------------------------------------- step thread
 
@@ -996,10 +1086,16 @@ class DataPlane:
                 continue
             if can_trim:
                 # Lazy retention: raise the trim watermark just enough
-                # for a full window past the current end. Everything
-                # below `end` is persisted (the slot is not busy), so
-                # trimmed rows remain servable from the store.
-                needed = end + B - S
+                # for a full window past the current end — but never
+                # above the PERSISTED prefix (self._log_end). `end` may
+                # be chain-predicted rounds ahead of what the resolver
+                # has persisted; an unclamped raise could let a
+                # concurrent read find nothing in the store below the
+                # watermark and silently skip committed rows. Clamped,
+                # a deep chain that outruns the ring simply fails the
+                # device capacity check on its later rounds and
+                # retries next dispatch.
+                needed = min(end + B - S, int(self._persisted[slot]))
                 if needed > self.trim[slot]:
                     self.trim[slot] = needed
                 # Rounds must never lap the ring boundary (live rows
@@ -1098,18 +1194,22 @@ class DataPlane:
                     continue
                 inp, ctx = work
                 with self._device_lock:
-                    if len(ctx["chain"]) == 1:
-                        self._state, out = self.fns.step_sparse(
-                            self._state, inp, ctx["entries_c"],
-                            ctx["slot_ids"], ctx["alive"], ctx["quorum"],
-                            ctx["trim"],
-                        )
-                    else:
-                        self._state, out = self.fns.step_many_sparse(
-                            self._state, inp, ctx["entries_c"],
-                            ctx["slot_ids"], ctx["alive"], ctx["quorum"],
-                            ctx["trim"],
-                        )
+                    try:
+                        if len(ctx["chain"]) == 1:
+                            self._state, out = self.fns.step_sparse(
+                                self._state, inp, ctx["entries_c"],
+                                ctx["slot_ids"], ctx["alive"], ctx["quorum"],
+                                ctx["trim"],
+                            )
+                        else:
+                            self._state, out = self.fns.step_many_sparse(
+                                self._state, inp, ctx["entries_c"],
+                                ctx["slot_ids"], ctx["alive"], ctx["quorum"],
+                                ctx["trim"],
+                            )
+                    except Exception as e:
+                        self._adopt_lockstep_state(e)
+                        raise
                 self.dispatches += 1
                 self.rounds += sum(
                     1 for rc in ctx["chain"]
@@ -1251,9 +1351,13 @@ class DataPlane:
         for rec_type, slot, base, payload in records:
             locator = self.store.append(rec_type, slot, base, payload)
             if rec_type == REC_APPEND and self.log_index is not None:
-                self.log_index.add(
-                    slot, base, len(payload) // self.cfg.slot_bytes, locator
-                )
+                nrows = len(payload) // self.cfg.slot_bytes
+                self.log_index.add(slot, base, nrows, locator)
+                with self._lock:
+                    # Only a SUCCESSFUL append moves the persisted
+                    # watermark (the trim clamp's authority).
+                    if base + nrows > self._persisted[slot]:
+                        self._persisted[slot] = base + nrows
         now = time.monotonic()
         if now - self._last_flush >= self.flush_interval_s:
             self.store.flush()
@@ -1269,6 +1373,7 @@ class DataPlane:
         ends = np.asarray(image.log_end, np.int64)
         with self._lock:
             self._log_end = ends.copy()
+            self._persisted = ends.copy()  # the image came FROM the store
             self.trim = np.maximum(0, ends - self.cfg.slots)
             self._scan_index = None  # history may differ on this store
             self._offsets_shadow = np.asarray(image.offsets, np.int32).copy()

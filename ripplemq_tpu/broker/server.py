@@ -424,18 +424,9 @@ class BrokerServer:
             if slots:
                 # One device fetch for ALL requested slots (a per-slot
                 # commit_index() loop would sync the device — and stall
-                # the round pipeline — once per slot).
-                commits = dp.log_ends().max(axis=0)  # committed == end
-                detail = {}
-                for s in slots:
-                    s = int(s)
-                    if 0 <= s < dp.cfg.partitions:
-                        detail[str(s)] = {
-                            "commit": int(commits[s]),
-                            "log_end": int(dp._log_end[s]),
-                            "trim": int(dp.trim[s]),
-                        }
-                engine["slots"] = detail
+                # the round pipeline — once per slot); shadow + trim are
+                # snapshotted consistently under the plane's lock.
+                engine["slots"] = dp.slot_detail(slots)
             stats["engine"] = engine
         return stats
 

@@ -138,7 +138,16 @@ class LockstepController:
         with self._lock:
             futs = self._send(method, args)
             result = local_fn()
-        self._check(futs)
+        try:
+            self._check(futs)
+        except Exception as e:
+            # The local launch already ran — donated input buffers are
+            # gone and `result` holds their replacement. Attach it so the
+            # caller (DataPlane) can adopt the new state and fail loudly
+            # with the lockstep-break diagnostic, instead of wedging every
+            # subsequent engine call on donated-buffer errors.
+            e.lockstep_result = result
+            raise
         return result
 
     # ---- engine surface (mirrors SpmdEngineFns) ----

@@ -270,21 +270,22 @@ def refill_from_peers(store_dir: str, list_fns, get_fn) -> list[str]:
     rs_dir = _rs_dir(store_dir)
     for stem, sources in sorted(remote.items()):
         # VALID local shards only — a corrupt shard file present on disk
-        # must not count toward reconstructability.
-        have = sum(
-            1 for p in shard_paths(store_dir, stem)
+        # must not count toward reconstructability, and must not block
+        # its index from being refilled (it gets overwritten below).
+        valid_idx = {
+            i for i, p in enumerate(shard_paths(store_dir, stem))
             if _read_shard(p) is not None
-        )
+        }
+        have = len(valid_idx)
         if have >= K:
             continue  # locally reconstructable already
         got = 0
-        seen_idx: set[int] = set()
+        seen_idx: set[int] = set(valid_idx)
         for peer, fname in sources:
             if have + got >= K:
                 break  # K shards reconstruct; repair re-encodes the rest
             idx = int(fname.rpartition(".shard")[2])
-            if idx in seen_idx or os.path.exists(os.path.join(rs_dir, fname)):
-                seen_idx.add(idx)
+            if idx in seen_idx:
                 continue
             try:
                 blob = get_fn(peer, fname)
