@@ -31,7 +31,7 @@ import numpy as np
 import struct
 import time
 
-from ripplemq_tpu.core.config import ALIGN, EngineConfig
+from ripplemq_tpu.core.config import ALIGN, ROW_HEADER as _HDR, EngineConfig
 from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
 from ripplemq_tpu.core.state import ReplicaState, StepInput, row_lens
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
@@ -94,6 +94,10 @@ def _fetch_global(x) -> np.ndarray:
 # load over more partitions to go past it).
 _OFFSET_HORIZON = (1 << 31) - (1 << 20)
 
+# Sentinel: a host-cache read lost the trim race mid-copy (see
+# DataPlane._read_cache).
+_CACHE_LAPPED = object()
+
 
 class _Pending:
     __slots__ = ("payloads", "future", "rounds_left")
@@ -133,6 +137,7 @@ class DataPlane:
         resolver_threads: int = 4,
         chain_depth: int = 4,
         read_q: int = 16,
+        host_read_cache: bool = True,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -154,6 +159,24 @@ class DataPlane:
         P0 = cfg.partitions
         self.trim = np.zeros((P0,), np.int64)
         self._log_end = np.zeros((P0,), np.int64)
+        # Host mirror of the committed device ring: every committed
+        # round's rows pass through this host (the resolver holds them
+        # to persist/replicate), so hot reads — above the trim
+        # watermark — can be served from host RAM with ZERO device
+        # involvement (the reference serves a consume as a leader-local
+        # list slice, PartitionStateMachine.java:85-110; behind a
+        # network tunnel a device read dispatch costs a full RTT).
+        # `_cache_end[p]` is the CONTIGUOUS mirrored prefix: it only
+        # advances when a round lands adjacent to it, so a resolve
+        # failure (round outcome unknown, rows never mirrored) leaves a
+        # gap that reads fall through to the device for, instead of
+        # serving stale rows. Memory = partitions x slots x slot_bytes
+        # (1/replicas of the device state); zero pages until written.
+        self._host_ring = (
+            np.zeros((P0, cfg.slots, cfg.slot_bytes), np.uint8)
+            if host_read_cache else None
+        )
+        self._cache_end = np.zeros((P0,), np.int64)
         # Persisted prefix per partition: rows below this are in the
         # ROUND STORE (appended; flush may lag by flush_interval_s).
         # Advanced by _persist_round only after the store append
@@ -312,6 +335,7 @@ class DataPlane:
         self.dispatches = 0
         self.read_queries = 0
         self.read_dispatches = 0
+        self.read_cache_hits = 0
         self.committed_entries = 0
         self.step_errors = 0
 
@@ -520,14 +544,19 @@ class DataPlane:
 
         Offsets below the retention watermark are served from the round
         store via the log index (only committed rounds are ever
-        persisted, so store reads need no commit bound); once the
-        consumer's position climbs back above the watermark, reads come
-        from the device ring again. A ring read races the step thread —
-        trim can advance and a committed round can recycle the window's
-        rows between the watermark check and the device read — so the
-        watermark is re-checked AFTER the read and a covered window is
-        re-served from the store (store records are immutable, so that
-        path is race-free)."""
+        persisted, so store reads need no commit bound). The HOT window
+        — above trim — is served from the host ring mirror with no
+        device dispatch (see __init__); only a mirror gap (resolve
+        failure) falls through to the device ring. A ring read races
+        the step thread — trim can advance and a committed round can
+        recycle the window's rows between the watermark check and the
+        read — so the watermark is re-checked AFTER the read and a
+        covered window is re-served from the store (store records are
+        immutable, so that path is race-free). `replica` only selects a
+        serving replica on the device paths: the mirror holds the
+        COMMITTED prefix, which is replica-invariant by the quorum
+        round's log-matching (per-replica divergence exists only above
+        commit, which no read path ever serves)."""
         if not 0 <= slot < self.cfg.partitions:
             raise ValueError(f"partition slot {slot} out of range")
         gc_races = 0
@@ -554,6 +583,13 @@ class DataPlane:
                 # earliest-reset to the watermark — rows >= trim are
                 # ring-resident — or this loop would spin forever.
                 offset = trim
+            if self._host_ring is not None:
+                res = self._read_cache(slot, offset, max_msgs)
+                if res is _CACHE_LAPPED:
+                    continue  # trim overran the window mid-copy: store-serve
+                if res is not None:
+                    self.read_cache_hits += 1
+                    return res
             fut: Future = Future()
             with self._read_lock:
                 if self._stop.is_set():
@@ -577,6 +613,58 @@ class DataPlane:
             next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
         else:
             next_offset = offset + count
+        return [m for _, m in with_pos], next_offset
+
+    def _read_cache(
+        self, slot: int, offset: int, max_msgs: Optional[int]
+    ) -> Optional[tuple[list[bytes], int]]:
+        """Serve one hot read from the host ring mirror. Returns the
+        (messages, next_offset) result, None to fall through to the
+        device (mirror gap after a resolve failure), or _CACHE_LAPPED
+        when trim overran the window mid-copy (caller retries; the next
+        pass store-serves). An offset at-or-past the committed end
+        answers empty WITHOUT device dispatch — the log-end shadow is
+        commit-exact, so tail polls are host-authoritative too."""
+        S = self.cfg.slots
+        with self._lock:
+            end = int(self._log_end[slot])
+            cend = int(self._cache_end[slot])
+        if offset >= end:
+            return [], offset  # caught up: nothing committed past offset
+        if offset >= cend:
+            return None  # mirror gap: the device ring is the authority
+        pos = offset % S
+        k = min(end - offset, cend - offset, self.cfg.read_batch)
+        if pos + k <= S:
+            rows = self._host_ring[slot, pos : pos + k].copy()
+        else:  # window spans the ring wrap, same as the device read
+            rows = np.concatenate([
+                self._host_ring[slot, pos:],
+                self._host_ring[slot, : pos + k - S],
+            ])
+        with self._lock:
+            lapped = int(self.trim[slot]) > offset
+        if lapped and self.log_index is not None:
+            return _CACHE_LAPPED  # rows may hold the next lap now
+        # Decode on flat bytes: one tobytes() for the window, then
+        # length-prefixed slices — ~3x the msgs/s of per-row numpy
+        # slicing on the host-RAM-bound consume path.
+        SB = self.cfg.slot_bytes
+        lens = np.minimum(np.asarray(row_lens(rows)), SB - _HDR)
+        flat = rows.tobytes()
+        # Lengths are clamped to the row capacity above — a corrupt
+        # length header must not bleed the next row's bytes into a
+        # message (the device/store decode paths clamp per row too).
+        with_pos = [
+            (i, flat[i * SB + _HDR : i * SB + _HDR + n])
+            for i, n in enumerate(lens.tolist())
+            if n > 0
+        ]
+        if max_msgs is not None and len(with_pos) > max(0, max_msgs):
+            with_pos = with_pos[: max(0, max_msgs)]
+            next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
+        else:
+            next_offset = offset + k
         return [m for _, m in with_pos], next_offset
 
     def _read_store(
@@ -1268,9 +1356,14 @@ class DataPlane:
             if committed.ndim == 1:
                 committed = committed[None]  # single round as a 1-chain
             chain = ctx["chain"]
-            # Advance the absolute-log-end shadow for every committed
-            # append FIRST (the device already advanced; a failure in the
-            # fallible work below must not leave the shadow behind).
+            records = []
+            for k, rc in enumerate(chain):
+                records.extend(self._round_records(rc, committed[k]))
+            # Mirror committed rows into the host ring BEFORE the shadow
+            # advance admits readers to them (both are infallible numpy
+            # work; the fallible persist/replicate below must not leave
+            # the shadow behind — the device already advanced).
+            self._mirror_records(records)
             # Chain bases are exact for committed rounds (prefix
             # property, see _drain).
             with self._lock:
@@ -1285,9 +1378,6 @@ class DataPlane:
                             for pend in taken_off:
                                 for cs, off in pend.payloads:
                                     self._offsets_shadow[slot, cs] = off
-            records = []
-            for k, rc in enumerate(chain):
-                records.extend(self._round_records(rc, committed[k]))
             self._persist_round(records)
             if self.replicate_fn is not None and records:
                 self.replicate_fn(records)
@@ -1319,6 +1409,33 @@ class DataPlane:
             with self._lock:
                 self._busy_a -= ctx["appends"].keys()
                 self._busy_o -= ctx["offsets"].keys()
+
+    def _mirror_records(self, records) -> None:
+        """Write committed append rows into the host ring mirror at
+        their ring positions and advance the contiguous-prefix
+        watermark. Advances are CONTIGUOUS only: a record landing past a
+        gap (an earlier round's resolve failed before mirroring) must
+        not mark the gap served — reads in it fall through to the
+        device ring, the authority the mirror shadows. Writes race only
+        readers (the slot's busy bit serializes writers per slot), and
+        any reader the write could corrupt is one whose window the trim
+        watermark already overran — exactly the race the read path
+        re-checks."""
+        if self._host_ring is None:
+            return
+        S, SB = self.cfg.slots, self.cfg.slot_bytes
+        for rec_type, slot, base, payload in records:
+            if rec_type != REC_APPEND:
+                continue
+            rows = np.frombuffer(payload, np.uint8).reshape(-1, SB)
+            pos = base % S
+            self._host_ring[slot, pos : pos + rows.shape[0]] = rows
+            with self._lock:
+                new_end = base + rows.shape[0]
+                if self._cache_end[slot] >= base:  # contiguous-prefix only
+                    self._cache_end[slot] = max(
+                        new_end, int(self._cache_end[slot])
+                    )
 
     def _round_records(self, rc: dict, committed
                        ) -> list[tuple[int, int, int, bytes]]:
@@ -1374,6 +1491,14 @@ class DataPlane:
         with self._lock:
             self._log_end = ends.copy()
             self._persisted = ends.copy()  # the image came FROM the store
+            if self._host_ring is not None:
+                # Seed the mirror from the replayed image: rows land at
+                # their ring positions during replay, so the first
+                # `slots` rows ARE the ring-resident window.
+                self._host_ring[:] = np.asarray(
+                    image.log_data, np.uint8
+                )[:, : self.cfg.slots]
+                self._cache_end = ends.copy()
             self.trim = np.maximum(0, ends - self.cfg.slots)
             self._scan_index = None  # history may differ on this store
             self._offsets_shadow = np.asarray(image.offsets, np.int32).copy()

@@ -416,6 +416,7 @@ class BrokerServer:
                 "dispatches": dp.dispatches,
                 "read_queries": dp.read_queries,
                 "read_dispatches": dp.read_dispatches,
+                "read_cache_hits": dp.read_cache_hits,
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
                 "partitions": dp.cfg.partitions,

@@ -77,7 +77,10 @@ def test_concurrent_consumers_share_dispatches():
     """Many threads polling concurrently must coalesce into few
     read_many dispatches while every reader sees exactly its data."""
     cfg = small_cfg(partitions=4, slots=256, max_batch=8, read_batch=8)
-    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(), read_q=16)
+    # Cache off: this test covers the DEVICE read coalescer, which is
+    # the fallback path when the host mirror has a gap.
+    dp = DataPlane(cfg, mode="local", store=MemoryRoundStore(), read_q=16,
+                   host_read_cache=False)
     dp.start()
     try:
         sent = {p: [] for p in range(4)}
