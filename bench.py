@@ -3,8 +3,28 @@
 Prints ONE JSON line:
   {"metric": "committed_appends_per_sec", "value": N, "unit": "appends/s",
    "vs_baseline": N, "baseline_appends_per_sec": N,
+   "shipped_shape_appends_per_sec": N,
    "p50_ack_ms": N, "p99_ack_ms": N, "p999_ack_ms": N,
-   "round_rtt_ms": N, "readback": "verified"}
+   "round_rtt_ms": N, "operating_curve": [...],
+   "consume_msgs_per_sec": N, "spmd_parity": {...},
+   "e2e_appends_per_sec": N, "e2e_mb_per_sec": N,
+   "readback": "verified", "e2e_readback": "verified"}
+
+Field map:
+- `value` — the ENGINE number: quorum rounds on device, input resident
+  (the program's ceiling).
+- `e2e_appends_per_sec` — the SYSTEM number: fresh distinct payloads
+  through producer clients → TCP → broker dispatch → batcher → device
+  rounds → store + standby replication (`_run_e2e`); nothing replayed.
+- `shipped_shape_appends_per_sec` — the engine measured at the
+  examples/cluster.yaml shape users actually boot.
+- `operating_curve` — (coalesce_s, chain_depth) → appends/s + p50/p99,
+  so the latency figures are points on a published curve.
+- `consume_msgs_per_sec` — host-ring-mirror consume drain (zero device
+  dispatch on the hot path; see broker/dataplane.py).
+- `spmd_parity` — local (vmap) vs spmd (shard_map, 1x1 mesh) dispatch
+  on the same chip; delta_pct must stay small for the production
+  binding to be trusted at the local binding's numbers.
 
 `round_rtt_ms` is the measured single-round dispatch+fetch time on this
 chip/link — the floor any ack latency pays; read the percentiles against
@@ -169,14 +189,18 @@ def _run_latency(cfg, submitters: int = 16,
         dp.warm(buckets=(8, 32))
         dp.submit_append(0, [PAYLOAD]).result(timeout=120)  # host path warm
         lats: list[float] = []
+        errors: list = []
 
         def worker(tid: int) -> None:
-            rng = np.random.default_rng(tid)
-            slots = rng.integers(0, cfg.partitions, size=per_thread)
-            for slot in slots:
-                t0 = time.perf_counter()
-                dp.submit_append(int(slot), [PAYLOAD]).result(timeout=60)
-                lats.append(time.perf_counter() - t0)
+            try:
+                rng = np.random.default_rng(tid)
+                slots = rng.integers(0, cfg.partitions, size=per_thread)
+                for slot in slots:
+                    t0 = time.perf_counter()
+                    dp.submit_append(int(slot), [PAYLOAD]).result(timeout=60)
+                    lats.append(time.perf_counter() - t0)
+            except Exception as e:  # a dead thread must fail the run,
+                errors.append((tid, repr(e)))  # not skew the percentiles
 
         threads = [
             threading.Thread(target=worker, args=(i,), daemon=True)
@@ -186,6 +210,7 @@ def _run_latency(cfg, submitters: int = 16,
             t.start()
         for t in threads:
             t.join()
+        assert not errors, f"latency submitters failed: {errors}"
         assert len(lats) == submitters * per_thread
         a = np.asarray(lats) * 1e3
         return {
@@ -249,6 +274,311 @@ def _run_consume(cfg, consumers: int = 16, rows_per_part: int = 96,
         dp.stop()
 
 
+def _run_curve(cfg, points=None, submitters: int = 16,
+               per_thread: int = 120) -> list[dict]:
+    """Latency/throughput operating curve: the same concurrent-producer
+    workload measured at several (coalesce_s, chain_depth) operating
+    points, so the published p50/p99 is a point on a curve, not one
+    configuration's anecdote. Offered load is fixed (submitters x
+    single-message appends, resubmitted on ack), so each point trades
+    ack latency against batching efficiency."""
+    import threading
+
+    from ripplemq_tpu.broker.dataplane import DataPlane
+
+    points = points or [
+        {"coalesce_s": 0.0, "chain_depth": 1},
+        {"coalesce_s": 0.002, "chain_depth": 4},   # shipped defaults
+        {"coalesce_s": 0.005, "chain_depth": 8},
+        {"coalesce_s": 0.02, "chain_depth": 8},
+    ]
+    curve = []
+    for pt in points:
+        dp = DataPlane(cfg, mode="local", coalesce_s=pt["coalesce_s"],
+                       chain_depth=pt["chain_depth"])
+        dp.start()
+        try:
+            for p in range(cfg.partitions):
+                dp.set_leader(p, 0, 1)
+            dp.warm(buckets=(8, 32))
+            dp.submit_append(0, [PAYLOAD]).result(timeout=120)
+            lats: list[float] = []
+            errors: list = []
+
+            def worker(tid: int) -> None:
+                try:
+                    rng = np.random.default_rng(tid)
+                    slots = rng.integers(0, cfg.partitions, size=per_thread)
+                    for slot in slots:
+                        t0 = time.perf_counter()
+                        dp.submit_append(int(slot), [PAYLOAD]).result(
+                            timeout=60)
+                        lats.append(time.perf_counter() - t0)
+                except Exception as e:  # a dead thread must fail the
+                    errors.append((tid, repr(e)))  # point, not skew it
+
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(submitters)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            assert not errors, f"curve submitters failed: {errors}"
+            assert len(lats) == submitters * per_thread
+            a = np.asarray(lats) * 1e3
+            curve.append({
+                **pt,
+                "offered_producers": submitters,
+                "appends_per_sec": round(len(lats) / dt, 1),
+                "p50_ack_ms": round(float(np.percentile(a, 50)), 3),
+                "p99_ack_ms": round(float(np.percentile(a, 99)), 3),
+                "rounds_per_dispatch": round(
+                    dp.rounds / max(1, dp.dispatches), 2),
+            })
+        finally:
+            dp.stop()
+    return curve
+
+
+def _run_spmd_parity(rounds: int = 64) -> dict:
+    """Dispatch parity: the production SPMD binding (shard_map over a
+    device mesh) vs the local binding (vmap) on the SAME single chip —
+    a 1x1 mesh with replicas=1, partitions unsharded. Proves the spmd
+    binding's dispatch overhead before anyone trusts it on a pod slice
+    (multi-chip semantics are covered by the virtual-mesh tests and
+    dryrun_multichip; this is the single-chip-provable slice)."""
+    import jax
+
+    from ripplemq_tpu.core.config import EngineConfig
+    from ripplemq_tpu.core.encode import build_step_input
+    from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
+    from ripplemq_tpu.parallel.mesh import make_mesh
+
+    cfg = EngineConfig(
+        partitions=256, replicas=1, slots=4096, slot_bytes=128,
+        max_batch=64, read_batch=32, max_consumers=64, max_offset_updates=8,
+    )
+    appends = {p: [PAYLOAD] * cfg.max_batch for p in range(cfg.partitions)}
+    inp = jax.device_put(build_step_input(cfg, appends=appends, leader=0,
+                                          term=1))
+    alive = np.ones((cfg.partitions, cfg.replicas), bool)
+    quorum = np.ones((cfg.partitions,), np.int32)
+    rates = {}
+    for name, fns in (
+        ("local", make_local_fns(cfg)),
+        ("spmd", make_spmd_fns(cfg, make_mesh(1, 1))),
+    ):
+        state = fns.init()
+        for _ in range(3):
+            state, out = fns.step(state, inp, alive, quorum)
+        np.asarray(out.committed)
+        state = fns.init()  # fresh log: timed rounds never hit capacity
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            state, out = fns.step(state, inp, alive, quorum)
+        committed = np.asarray(out.committed)  # host fetch = fence
+        dt = time.perf_counter() - t0
+        assert bool(committed.all())
+        rates[name] = rounds * cfg.partitions * cfg.max_batch / dt
+    # Signed: positive = the production (spmd) binding is FASTER than
+    # the local binding; the trust criterion is that it not be
+    # meaningfully slower (delta_pct > -10).
+    delta = (rates["spmd"] - rates["local"]) / rates["local"]
+    return {
+        "local_appends_per_sec": round(rates["local"], 1),
+        "spmd_appends_per_sec": round(rates["spmd"], 1),
+        "delta_pct": round(100 * delta, 2),
+    }
+
+
+def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
+             threads: int = 8, batch: int = 256, window: int = 4) -> dict:
+    """END-TO-END produce throughput: fresh, distinct payloads streamed
+    by real producer clients through TCP sockets, broker dispatch, the
+    DataPlane batcher, device quorum rounds, the round store, AND the
+    standby replication stream — nothing resident-input-replayed. This
+    is the number the reference's implied metric means (its path IS its
+    socket path, mq-common/.../PartitionClient.java:31-59; SURVEY.md §6).
+
+    Topology: a 3-broker cluster (controller + 2 replication standbys)
+    over real loopback TCP, all in this process — the bench host has a
+    SINGLE CPU core (verified via nproc), so a multi-process topology
+    only measures scheduler thrash; threads on one core exercise the
+    identical code path (sockets, codec, dispatch, batcher, store,
+    standby stream) at strictly less overhead. Partition leaders
+    collocate on the controller (manager.plan_elections prefers the
+    engine host on log ties), so producers talk straight to the broker
+    that owns the device program, as a single-chip deployment would be
+    configured. The figure is therefore a single-core-host +
+    network-tunneled-chip number — a floor, not a ceiling, for real
+    deployments."""
+    import os
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    from collections import deque
+
+    from ripplemq_tpu.broker.server import BrokerServer
+    from ripplemq_tpu.metadata.cluster_config import parse_cluster_config
+
+    socks = [socket.socket() for _ in range(n_brokers)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+
+    raw = {
+        "brokers": [{"id": i, "host": "127.0.0.1", "port": p}
+                    for i, p in enumerate(ports)],
+        "topics": [{"name": "bench", "partitions": 1024,
+                    "replication_factor": 3}],
+        # The engine-headline shape (RF 3 here: topic RF is capped by
+        # the broker count; the engine still runs R=5 replica slots).
+        "engine": {
+            "partitions": 1024, "replicas": 5, "slots": 12352,
+            "slot_bytes": 128, "max_batch": 256, "read_batch": 32,
+            "max_consumers": 64, "max_offset_updates": 8,
+        },
+        "election_timeout_s": 0.5,
+        "metadata_election_timeout_s": 1.5,
+        "membership_poll_s": 0.5,
+        "rpc_timeout_s": 60.0,   # a queued append must outlive a backlog
+        "rpc_workers": 64,       # workers block on round futures (see
+                                 # ClusterConfig.rpc_workers)
+    }
+    tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
+    config = parse_cluster_config(raw)
+    brokers = []
+    try:
+        for i in range(n_brokers):
+            b = BrokerServer(i, config, net=None,
+                             data_dir=os.path.join(tmp, f"d{i}"))
+            b.start()
+            brokers.append(b)
+        controller = brokers[0]
+
+        from ripplemq_tpu.client.consumer import ConsumerClient
+        from ripplemq_tpu.client.metadata import MetadataManager
+        from ripplemq_tpu.client.producer import ProducerClient
+        from ripplemq_tpu.wire.transport import TcpClient
+
+        bootstrap = [f"127.0.0.1:{p}" for p in ports]
+        transport = TcpClient()
+        meta = MetadataManager(transport, bootstrap,
+                               refresh_interval_s=3600, rpc_timeout_s=5.0)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                meta.refresh()
+                t = meta.topic("bench")
+                if (t is not None and t.assignments
+                        and all(a.leader is not None
+                                for a in t.assignments)):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        else:
+            raise AssertionError("e2e cluster never elected all leaders")
+        meta.close()
+        transport.close()
+
+        # Compile every active-set bucket the wave can hit, then warm
+        # the client path (connections + metadata) once.
+        controller.dataplane.warm(buckets=(8, 32, 128, 512, 1024))
+        pc = ProducerClient(bootstrap, rpc_timeout_s=120.0)
+        pc.produce_batch("bench", [b"e2e-warmup"] * 8)
+
+        counts = {}
+        errors: list = []
+        t0 = time.monotonic()
+        stop_at = t0 + duration_s
+
+        def producer(tid: int) -> None:
+            try:
+                _producer(tid)
+            except Exception as e:  # a dead thread must FAIL the bench,
+                errors.append((tid, repr(e)))  # not deflate its number
+
+        def _producer(tid: int) -> None:
+            acked = nbytes = seq = 0
+            pending: deque = deque()
+
+            def land(w, n, nb):
+                nonlocal acked, nbytes
+                w()
+                acked += n
+                nbytes += nb
+
+            while time.monotonic() < stop_at:
+                while len(pending) >= window:
+                    land(*pending.popleft())
+                payloads = []
+                for _ in range(batch):
+                    head = b"e2e-%d-%08d|" % (tid, seq)
+                    seq += 1
+                    payloads.append(head.ljust(100, b"x"))
+                nb = sum(map(len, payloads))
+                w = pc.produce_batch_async("bench", payloads)
+                pending.append((w, batch, nb))
+            while pending:
+                land(*pending.popleft())
+            counts[tid] = (acked, nbytes)
+
+        workers = [threading.Thread(target=producer, args=(i,), daemon=True)
+                   for i in range(threads)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        secs = time.monotonic() - t0
+        assert not errors, f"producer threads failed: {errors}"
+        assert len(counts) == threads
+        acked = sum(v[0] for v in counts.values())
+        nbytes = sum(v[1] for v in counts.values())
+        pc.close()
+        assert acked > 0
+
+        # Readback honesty: consume a window back through the client SDK
+        # and check the loadgen payload structure survived byte-exact.
+        cc = ConsumerClient(bootstrap, "e2e-verify", rpc_timeout_s=60.0)
+        checked = 0
+        for _ in range(40):
+            for m in cc.consume("bench"):
+                if m.startswith(b"e2e-warmup"):
+                    continue
+                head, _, pad = m.partition(b"|")
+                tag, tid, seq = head.split(b"-")
+                assert tag == b"e2e" and tid.isdigit() and seq.isdigit(), m[:24]
+                assert pad == b"x" * len(pad) and len(m) == 100, m[:24]
+                checked += 1
+            if checked >= 256:
+                break
+        assert checked >= 256, f"only {checked} messages read back"
+        cc.close()
+
+        # The controller's committed-entry count must cover every ack.
+        dp = controller.dataplane
+        assert dp is not None and dp.committed_entries >= acked
+        return {
+            "e2e_appends_per_sec": round(acked / secs, 1),
+            "e2e_mb_per_sec": round(nbytes / secs / 1e6, 2),
+            "e2e_acked": acked,
+            "e2e_seconds": round(secs, 1),
+            "e2e_readback": "verified",
+        }
+    finally:
+        for b in brokers:
+            b.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _round_rtt(cfg, samples: int = 8) -> float:
     """Median single-round dispatch+fetch time (ms): the latency floor of
     one quorum round on this chip/link."""
@@ -280,6 +610,18 @@ def main() -> None:
     tpu_rate = _run_mode(tpu_cfg, batch_per_partition=256, rounds=48,
                          warmup=1, verify=True, chain=8)
 
+    # The SHIPPED example shape (examples/cluster.yaml engine:) at the
+    # broker's default chain depth — the configuration users actually
+    # boot, measured as shipped.
+    shipped_cfg = EngineConfig(
+        partitions=8, replicas=3, slots=4096, slot_bytes=256,
+        max_batch=32, read_batch=32, max_consumers=64, max_offset_updates=8,
+    )
+    # 96 rounds x 32 rows = 3072 < 4096 slots (no store/trim here, so
+    # the timed window must fit the ring).
+    shipped_rate = _run_mode(shipped_cfg, batch_per_partition=32,
+                             rounds=96, warmup=2, chain=4)
+
     # Baseline mode: the reference's shape — 1 partition, RF 5, ONE entry
     # per strictly-sequential round (max_batch stays at the ALIGN minimum;
     # only one row per round carries a payload).
@@ -299,11 +641,14 @@ def main() -> None:
     )
     lat = _run_latency(lat_cfg)
     rtt_ms = _round_rtt(lat_cfg)
+    curve = _run_curve(lat_cfg)
     consume_cfg = EngineConfig(
         partitions=1024, replicas=5, slots=2048, slot_bytes=128,
         max_batch=32, read_batch=64, max_consumers=64, max_offset_updates=8,
     )
     consume_rate = _run_consume(consume_cfg, consumers=32)
+    spmd = _run_spmd_parity()
+    e2e = _run_e2e()
 
     print(
         json.dumps(
@@ -314,12 +659,17 @@ def main() -> None:
                 "vs_baseline": round(tpu_rate / base_rate, 2),
                 "baseline_appends_per_sec": round(base_rate, 1),
                 "config": "P=1024 R=5 B=256 chain=8",
+                "shipped_shape_appends_per_sec": round(shipped_rate, 1),
+                "shipped_config": "P=8 R=3 B=32 SB=256 chain=4",
                 "p50_ack_ms": round(lat["p50"], 3),
                 "p99_ack_ms": round(lat["p99"], 3),
                 "p999_ack_ms": round(lat["p999"], 3),
                 "round_rtt_ms": round(rtt_ms, 3),
+                "operating_curve": curve,
                 "consume_msgs_per_sec": round(consume_rate, 1),
+                "spmd_parity": spmd,
                 "readback": "verified",
+                **e2e,
             }
         )
     )

@@ -32,7 +32,11 @@ import struct
 import time
 
 from ripplemq_tpu.core.config import ALIGN, ROW_HEADER as _HDR, EngineConfig
-from ripplemq_tpu.core.encode import decode_entries_with_pos, pack_rows
+from ripplemq_tpu.core.encode import (
+    decode_entries_with_pos,
+    pack_payload_rows,
+    stamp_term,
+)
 from ripplemq_tpu.core.state import ReplicaState, StepInput, row_lens
 from ripplemq_tpu.parallel.engine import make_local_fns, make_spmd_fns
 from ripplemq_tpu.parallel.mesh import make_mesh
@@ -100,10 +104,16 @@ _CACHE_LAPPED = object()
 
 
 class _Pending:
-    __slots__ = ("payloads", "future", "rounds_left")
+    __slots__ = ("payloads", "rows", "future", "rounds_left")
 
-    def __init__(self, payloads: list[bytes], future: Future, rounds_left: int):
+    def __init__(self, payloads: list[bytes], future: Future,
+                 rounds_left: int, rows=None):
         self.payloads = payloads
+        # Appends carry their rows PRE-PACKED (pack_payload_rows on the
+        # submitting thread); the drain only memcpys blocks and stamps
+        # the round term — per-message packing inside the batcher lock
+        # serialized the whole plane under deep backlogs.
+        self.rows = rows
         self.future = future
         self.rounds_left = rounds_left
 
@@ -424,6 +434,17 @@ class DataPlane:
             return fetch(self._state, field)
         return _fetch_global(getattr(self._state, field))
 
+    def busy(self) -> bool:
+        """True while rounds are queued or in flight. Duty-loop callers
+        use this to defer OPTIONAL device fetches (repair scans): a
+        state fetch must wait for every dispatched round to execute —
+        while holding the device lock — so fetching on a busy plane
+        drains the whole dispatch pipeline (measured as multi-second
+        throughput collapses every repair-scan tick)."""
+        with self._lock:
+            queued = bool(self._appends) or bool(self._offsets)
+        return queued or not self._inflight.empty()
+
     def log_ends(self) -> np.ndarray:
         """Per-replica log ends [R, P] — the lag map the repair loop uses
         to find replicas needing resync."""
@@ -479,6 +500,7 @@ class DataPlane:
                     )
                 )
                 return fut
+        rows = pack_payload_rows(self.cfg, payloads)  # off-lock packing
         with self._lock:
             if self._log_end[slot] >= _OFFSET_HORIZON:
                 fut.set_exception(
@@ -490,7 +512,7 @@ class DataPlane:
                 )
                 return fut
             self._appends.setdefault(slot, []).append(
-                _Pending(list(payloads), fut, self.max_retry_rounds)
+                _Pending(list(payloads), fut, self.max_retry_rounds, rows)
             )
         self._work.set()
         return fut
@@ -629,6 +651,15 @@ class DataPlane:
         with self._lock:
             end = int(self._log_end[slot])
             cend = int(self._cache_end[slot])
+            dirty = slot in self._shadow_dirty
+        if dirty:
+            # A resolve failed with the slot's round outcome unknown:
+            # the log-end shadow may TRAIL device-committed rows until
+            # the next drain re-derives it, so an empty answer here
+            # could hide a committed suffix indefinitely on an idle
+            # partition. The device path's commit bound is the
+            # authority.
+            return None
         if offset >= end:
             return [], offset  # caught up: nothing committed past offset
         if offset >= cend:
@@ -1194,15 +1225,20 @@ class DataPlane:
                 cap = B  # store-less: bounded log, old behavior
             taken: list[tuple[_Pending, int, int]] = []
             fill = 0
-            batch: list[bytes] = []
             while queue and fill + len(queue[0].payloads) <= cap:
                 pend = queue.pop(0)
                 n = len(pend.payloads)
                 taken.append((pend, fill, n))
-                batch.extend(pend.payloads)
                 fill += n
             if taken:
-                blocks[slot] = pack_rows(cfg, batch, int(self.term[slot]))
+                # Assemble pre-packed row blocks (C memcpys), then stamp
+                # the round term over every row — padding included — in
+                # one vectorized write. No per-message work here.
+                block = np.zeros((B, SB), np.uint8)
+                for pend, start, n in taken:
+                    block[start : start + n] = pend.rows
+                stamp_term(block, int(self.term[slot]))
+                blocks[slot] = block
                 counts[slot] = fill
                 round_appends[slot] = taken
                 round_bases[slot] = end
@@ -1214,7 +1250,9 @@ class DataPlane:
                 # the term; decode skips them) so the next round
                 # starts the lap at ring position 0.
                 pad = S - end % S  # < B here (head <= B did not fit)
-                blocks[slot] = pack_rows(cfg, [], int(self.term[slot]))
+                block = np.zeros((B, SB), np.uint8)
+                stamp_term(block, int(self.term[slot]))
+                blocks[slot] = block
                 counts[slot] = pad
                 round_appends[slot] = []
                 round_bases[slot] = end
@@ -1432,7 +1470,14 @@ class DataPlane:
             self._host_ring[slot, pos : pos + rows.shape[0]] = rows
             with self._lock:
                 new_end = base + rows.shape[0]
-                if self._cache_end[slot] >= base:  # contiguous-prefix only
+                # Contiguous-prefix advance — OR gap healing: once the
+                # trim watermark reaches this round's base, everything
+                # unmirrored sits below trim (store-served; reads never
+                # consult the mirror there), so the mirror is valid
+                # again from `base` and the cache need not stay disabled
+                # for the slot's lifetime after one resolve failure.
+                if (self._cache_end[slot] >= base
+                        or int(self.trim[slot]) >= base):
                     self._cache_end[slot] = max(
                         new_end, int(self._cache_end[slot])
                     )

@@ -55,6 +55,13 @@ OP_REGISTER_CONSUMER = "register_consumer"
 # a full copy of its committed-round stream (the standby set).
 OP_SET_CONTROLLER = "set_controller"
 OP_SET_STANDBYS = "set_standbys"
+# N commands applied atomically as ONE hostraft entry. Exists because a
+# thousand-partition election wave must not pay a thousand per-entry
+# proposal/broadcast costs: the controller advertises every winner of a
+# batched device ballot in one replicated command (the reference has no
+# analogue — each JRaft group advertises its own leader independently,
+# PartitionManager.java:200-253).
+OP_BATCH = "batch"
 
 
 def build_slot_map(config: ClusterConfig) -> dict[GroupKey, int]:
@@ -104,29 +111,37 @@ class PartitionManager:
         """hostraft apply_fn: committed metadata commands, in log order."""
         with self.lock:
             self._applied_index = index
-            op = cmd.get("op")
-            if op == OP_SET_TOPICS:
-                self._apply_set_topics(
-                    topics_from_wire(cmd["topics"]), [int(b) for b in cmd["live"]]
-                )
-            elif op == OP_SET_LEADER:
-                self._apply_set_leader(
-                    cmd["topic"], int(cmd["partition"]),
-                    None if cmd["leader"] is None else int(cmd["leader"]),
-                    int(cmd["term"]),
-                )
-            elif op == OP_REGISTER_CONSUMER:
-                self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
-            elif op == OP_SET_CONTROLLER:
-                self._apply_set_controller(
-                    int(cmd["controller"]), int(cmd["epoch"]),
-                    [int(b) for b in cmd["standbys"]],
-                )
-            elif op == OP_SET_STANDBYS:
-                self._apply_set_standbys(
-                    int(cmd["epoch"]), [int(b) for b in cmd["standbys"]]
-                )
-            # Unknown ops are ignored (forward compatibility).
+            if cmd.get("op") == OP_BATCH:
+                for sub in cmd["cmds"]:
+                    self._apply_one(sub)
+            else:
+                self._apply_one(cmd)
+
+    def _apply_one(self, cmd: dict) -> None:
+        """One command, lock held (apply + OP_BATCH expansion)."""
+        op = cmd.get("op")
+        if op == OP_SET_TOPICS:
+            self._apply_set_topics(
+                topics_from_wire(cmd["topics"]), [int(b) for b in cmd["live"]]
+            )
+        elif op == OP_SET_LEADER:
+            self._apply_set_leader(
+                cmd["topic"], int(cmd["partition"]),
+                None if cmd["leader"] is None else int(cmd["leader"]),
+                int(cmd["term"]),
+            )
+        elif op == OP_REGISTER_CONSUMER:
+            self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
+        elif op == OP_SET_CONTROLLER:
+            self._apply_set_controller(
+                int(cmd["controller"]), int(cmd["epoch"]),
+                [int(b) for b in cmd["standbys"]],
+            )
+        elif op == OP_SET_STANDBYS:
+            self._apply_set_standbys(
+                int(cmd["epoch"]), [int(b) for b in cmd["standbys"]]
+            )
+        # Unknown ops are ignored (forward compatibility).
 
     def snapshot(self) -> dict:
         """hostraft snapshot_fn — metadata state for log compaction."""
@@ -469,6 +484,43 @@ class PartitionManager:
 
     # --------------------------------------------- controller duty logic
 
+    def needs_elections(self) -> bool:
+        """Cheap host-only pre-check for the controller duty: would
+        plan_elections actually NOMINATE anyone? plan_elections needs a
+        device log-ends fetch to pick candidates; that fetch holds the
+        device lock for a full host-device round trip, so the duty loop
+        must not pay it every tick — neither on a healthy cluster nor
+        for a partition that is leaderless but CANNOT elect (quorum of
+        its replicas dead) or is inside its election debounce window.
+        Mirrors plan_elections' own gates (leaderless, quorum of live
+        replicas, debounce elapsed) without stamping the debounce
+        table."""
+        with self.lock:
+            if self.dataplane is None:
+                return False
+            live = set(self.live)
+            R = self.dataplane.cfg.replicas
+            now = time.monotonic()
+            for t in self.topics:
+                quorum = t.replication_factor // 2 + 1
+                for a in t.assignments:
+                    if a.leader is not None and a.leader in live:
+                        continue
+                    slot = self.slot_map.get((t.name, a.partition_id))
+                    if slot is None:
+                        continue
+                    since = self._leaderless_since.get(slot)
+                    if (since is not None
+                            and now - since < self.config.election_timeout_s):
+                        continue  # debouncing: not actionable yet
+                    alive_n = sum(
+                        1 for r, b in enumerate(a.replicas)
+                        if b in live and r < R
+                    )
+                    if alive_n >= quorum:
+                        return True
+            return False
+
     def plan_elections(
         self, log_ends: Optional[np.ndarray] = None
     ) -> tuple[dict[int, tuple[int, int]], dict[int, dict]]:
@@ -506,8 +558,23 @@ class PartitionManager:
                     ]
                     if len(alive_replicas) < t.replication_factor // 2 + 1:
                         continue  # no quorum: stay leaderless
+                    # Longest log wins (vote_step still enforces
+                    # up-to-dateness on device). Ties prefer the replica
+                    # hosted on the CONTROLLER broker: every append
+                    # executes on the controller's device program anyway,
+                    # so leadership elsewhere just buys each produce an
+                    # extra broker-to-broker forwarding hop (measured as
+                    # the e2e throughput cap — follower processes spend
+                    # seconds per ack wave on codec work). Failover keeps
+                    # this honest: a new controller wins the ties only
+                    # where its log matches the longest.
                     r_best, b_best = max(
-                        alive_replicas, key=lambda rb: (int(log_ends[rb[0], slot]), -rb[0])
+                        alive_replicas,
+                        key=lambda rb: (
+                            int(log_ends[rb[0], slot]),
+                            rb[1] == self.controller_broker,
+                            -rb[0],
+                        ),
                     )
                     new_term = max(a.term, int(device_terms[slot])) + 1
                     cands[slot] = (r_best, new_term)

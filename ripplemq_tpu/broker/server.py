@@ -46,6 +46,7 @@ from typing import Callable, Optional
 from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner
 from ripplemq_tpu.broker.manager import (
+    OP_BATCH,
     OP_REGISTER_CONSUMER,
     OP_SET_STANDBYS,
     PartitionManager,
@@ -98,10 +99,23 @@ class BrokerServer:
         # out to live peers) ---
         if net is not None:
             self.client: Transport = net.client(self.addr)
+            # Same source address (fault injection must treat raft
+            # traffic exactly like data traffic), distinct client object.
+            self._raft_client: Transport = net.client(self.addr)
             self._tcp_server = None
         else:
             self.client = TcpClient()
-            self._tcp_server = TcpServer(self.info.host, self.info.port, self.dispatch)
+            # The metadata plane gets its OWN connections: raft appends
+            # and meta proposals must not queue behind megabyte
+            # replication/engine frames on the shared pipelined sockets
+            # (head-of-line blocking there stalls commits for seconds
+            # under produce load — elections, standby joins, and
+            # failover all ride these messages).
+            self._raft_client = TcpClient()
+            self._tcp_server = TcpServer(
+                self.info.host, self.info.port, self.dispatch,
+                workers=config.rpc_workers,
+            )
 
         # --- committed-round store ---
         # EVERY broker holds one, so any broker can serve as a replication
@@ -185,7 +199,7 @@ class BrokerServer:
                 node.restore(saved)
         self.runner = RaftRunner(
             node,
-            self.client,
+            self._raft_client,
             addr_of=self._addr_of,
             tick_interval_s=tick_interval_s,
             rpc_timeout_s=min(1.0, config.rpc_timeout_s),
@@ -217,6 +231,9 @@ class BrokerServer:
         # TopicsRaftServer.java:216): assignment/controller planning runs
         # at most every membership_poll_s, first pass immediate.
         self._last_membership_poll = 0.0
+        # Repair-scan cadence (see _controller_duty): lag repair needs a
+        # device fetch, so it must not ride every duty tick.
+        self._last_repair_scan = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -324,6 +341,7 @@ class BrokerServer:
         if self._owns_store and self._round_store is not None:
             self._round_store.close()
         self.client.close()
+        self._raft_client.close()
 
     # ------------------------------------------------------------- dispatch
 
@@ -740,7 +758,7 @@ class BrokerServer:
                 hint = node.leader_hint
                 if hint is not None and hint != self.broker_id:
                     try:
-                        resp = self.client.call(
+                        resp = self._raft_client.call(
                             self._addr_of(hint),
                             {"type": "meta.propose", "cmd": cmd},
                             timeout=self.config.rpc_timeout_s,
@@ -1080,20 +1098,47 @@ class BrokerServer:
         dp = self._local_engine()
         if dp is None:
             return
-        # One [R, P] log-ends snapshot per tick, shared by both planners
+        # Touch the device ONLY when there is work: the log-ends fetch
+        # holds the device lock for a full host-device RTT, and a duty
+        # loop fetching every tick starves the dispatch pipeline (~4
+        # rounds/s measured behind a tunnel vs ~20+ without). Elections
+        # have a cheap host-side pre-check; repairs run on their own
+        # cadence.
+        # Repair scans defer while the plane is busy (the fetch would
+        # drain the dispatch pipeline; see DataPlane.busy) — but never
+        # beyond 30 s, so lagging replicas still catch up under
+        # sustained load.
+        since_repair = time.monotonic() - self._last_repair_scan
+        due_repairs = since_repair >= max(1.0, self._duty_interval_s * 10)
+        if due_repairs and dp.busy() and since_repair < 30.0:
+            due_repairs = False
+        if not self.manager.needs_elections() and not due_repairs:
+            return
+        # One [R, P] log-ends snapshot per pass, shared by both planners
         # (elections don't move log ends, so the snapshot stays valid).
         log_ends = dp.log_ends()
         cands, drafts = self.manager.plan_elections(log_ends)
         if cands:
             winners = dp.elect(cands)
-            for slot, won in winners.items():
-                if won:
-                    self.propose_cmd(drafts[slot], retries=1)
+            won = [drafts[slot] for slot, w in winners.items() if w]
+            # ONE replicated command advertises every winner of the
+            # batched ballot (chunked to bound the entry size): a
+            # thousand-partition election wave — bootstrap or failover —
+            # must not pay a thousand per-proposal broadcast costs.
+            for i in range(0, len(won), 512):
+                chunk = won[i : i + 512]
+                if len(chunk) == 1:
+                    self.propose_cmd(chunk[0], retries=1)
+                else:
+                    self.propose_cmd({"op": OP_BATCH, "cmds": chunk},
+                                     retries=1)
         # Periodic lag repair: catch up alive followers that trail their
         # leader (covers post-election catch-up and slots that came alive
         # while the partition was leaderless).
-        for (src, dst), slots in self.manager.plan_repairs(log_ends).items():
-            dp.resync(src, dst, slots)
+        if due_repairs:
+            self._last_repair_scan = time.monotonic()
+            for (src, dst), slots in self.manager.plan_repairs(log_ends).items():
+                dp.resync(src, dst, slots)
 
     def _standby_duty(self) -> None:
         """Controller: maintain the standby set — drop suspects stalling
@@ -1141,11 +1186,23 @@ class BrokerServer:
         try:
             rep.catchup(cand, self._round_store)
             members = sorted(set(self.manager.current_standbys()) | {cand})
-            if self.propose_cmd(
-                {"op": OP_SET_STANDBYS, "epoch": epoch, "standbys": members},
-                retries=10,
-            ):
-                deadline = time.monotonic() + 10.0
+            # The joiner holds the full prefix AND keeps receiving live
+            # rounds (it stays in the joining set), so a lagging
+            # membership commit is retried by RE-PROPOSING — never by
+            # re-streaming the store (under produce load the metadata
+            # apply can trail by seconds, and a from-scratch catch-up
+            # retry loop would amplify exactly the load that caused the
+            # lag).
+            for _ in range(5):
+                if not self.propose_cmd(
+                    {"op": OP_SET_STANDBYS, "epoch": epoch,
+                     "standbys": members},
+                    retries=3,
+                ):
+                    continue
+                deadline = time.monotonic() + max(
+                    10.0, self.config.rpc_timeout_s
+                )
                 while time.monotonic() < deadline:
                     if cand in self.manager.current_standbys():
                         joined = True
@@ -1153,6 +1210,8 @@ class BrokerServer:
                     if self.manager.current_epoch() != epoch:
                         return  # deposed mid-join; fence duty cleans up
                     time.sleep(0.02)
+                if joined:
+                    break
             if joined:
                 log.info("broker %d: standby %d caught up and joined the "
                          "standby set", self.broker_id, cand)

@@ -101,6 +101,44 @@ class ProducerClient:
             time.sleep(self._backoff)
         raise ProduceError(f"produce to {topic} failed: {last_err}")
 
+    def produce_batch_async(self, topic: str, messages: list[bytes],
+                            partition: Optional[int] = None):
+        """Pipelined produce: returns a waiter `() -> int` (first
+        assigned offset). Many batches can be in flight per connection —
+        the TcpClient pipelines frames by request id — so one producer
+        thread can keep a whole window of rounds in the broker's batcher
+        (the reference's client is strictly one sync RPC at a time,
+        PartitionClient.java:31-59). No retry/refresh logic on this
+        path: the waiter raises ProduceError on any failure and the
+        caller decides (a windowed sender usually just re-sends)."""
+        if not messages:
+            raise ValueError("empty batch")
+        call_async = getattr(self._transport, "call_async", None)
+        if call_async is None:
+            resp_val = self.produce_batch(topic, messages,
+                                          partition=partition)
+            return lambda: resp_val
+        t = self._meta.topic(topic)
+        if t is None:
+            raise ProduceError(f"unknown topic {topic!r}")
+        pid = self._selector.select(t) if partition is None else partition
+        addr = self._meta.leader_addr(topic, pid)
+        if addr is None:
+            raise ProduceError(f"no leader known for {topic}[{pid}]")
+        fut = call_async(
+            addr,
+            {"type": "produce", "topic": topic, "partition": pid,
+             "messages": list(messages)},
+        )
+
+        def wait() -> int:
+            resp = fut.result(timeout=self._timeout)
+            if not resp.get("ok"):
+                raise ProduceError(str(resp.get("error", "produce failed")))
+            return int(resp["base_offset"])
+
+        return wait
+
     def close(self) -> None:
         self._meta.close()
         if self._owns_transport:

@@ -63,6 +63,13 @@ class ClusterConfig:
     # record). None = unlimited — the default, and strictly more than
     # the reference retains (its partition state is JVM-heap-bounded).
     store_retention_bytes: int | None = None
+    # RPC worker pool per broker. A produce/engine.append handler BLOCKS
+    # its worker until the round commits, so this caps a broker's
+    # in-flight appends — size it to the offered concurrency (threads
+    # are cheap; they spend their life waiting on round futures). The
+    # reference has no analogue: Bolt dispatches on its own pool and
+    # every request blocks a JRaft apply anyway.
+    rpc_workers: int = 16
 
     def __post_init__(self) -> None:
         # Shards (~segment_bytes / 3 each) travel in single wire frames
@@ -162,6 +169,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["controller_id"] = int(raw["controller_id"])
     if "standby_count" in raw:
         extra["standby_count"] = int(raw["standby_count"])
+    if "rpc_workers" in raw:
+        extra["rpc_workers"] = int(raw["rpc_workers"])
     if "segment_bytes" in raw:
         extra["segment_bytes"] = int(raw["segment_bytes"])
     if raw.get("store_retention_bytes") is not None:

@@ -230,7 +230,8 @@ def _gf_matmul_jit(coeffs, shards, n, use_pallas, interpret):
 
 
 def gf_matmul(coeffs, shards, *, use_pallas: bool | None = None,
-              interpret: bool = False) -> jax.Array:
+              interpret: bool = False,
+              platform: str | None = None) -> jax.Array:
     """[M, K] static coefficient matrix ·_gf [K, N] uint8 shards → [M, N].
 
     `coeffs` must be a tuple of tuples of python ints (it is baked into
@@ -238,8 +239,25 @@ def gf_matmul(coeffs, shards, *, use_pallas: bool | None = None,
     one of the C(k+m, k) inverses — each pattern compiles once). Shards
     are zero-padded to the packing width internally (zeros encode to
     zeros — GF linearity — so the slice back is exact).
+
+    `platform` pins execution to that backend's first device ("cpu"
+    runs the XLA fallback on host cores). The storage plane uses
+    platform="cpu": segment-scale encodes must not ride the accelerator
+    link — where the chip sits behind a network tunnel, fetching tens
+    of MB of parity would clog the link the data plane's rounds live
+    on (measured ~2-5 MB/s device→host there, i.e. ~10 s per sealed
+    segment). The Pallas TPU kernel remains the right choice when the
+    chip is PCIe-attached (D2H at GB/s).
     """
     coeffs = tuple(tuple(int(c) for c in row) for row in coeffs)
+    if platform is not None:
+        dev = jax.devices(platform)[0]
+        if use_pallas is None:
+            use_pallas = platform == "tpu"
+        shards = jax.device_put(np.asarray(shards, np.uint8), dev)
+        with jax.default_device(dev):
+            return gf_matmul(coeffs, shards, use_pallas=use_pallas,
+                             interpret=interpret)
     shards = jnp.asarray(shards, jnp.uint8)
     if shards.ndim != 2 or len(coeffs) == 0 or len(coeffs[0]) != shards.shape[0]:
         raise ValueError(

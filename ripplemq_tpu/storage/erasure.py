@@ -66,7 +66,15 @@ def _shard_length(orig_len: int) -> int:
 
 def encode_segment(store_dir: str, seg_name: str, **kw) -> list[str]:
     """Write the K+M shard files for one sealed segment. Atomic per shard
-    (tmp + rename); returns the shard paths."""
+    (tmp + rename); returns the shard paths.
+
+    The GF matmul defaults to the HOST CPU backend here: the storage
+    plane must not ride the accelerator link — a segment-scale parity
+    fetch over a network-tunneled chip (~2-5 MB/s device→host) clogs
+    the link the data plane's quorum rounds depend on for ~10 s per
+    seal. Pass platform=None/use_pallas to route it to the TPU kernel
+    on PCIe-attached deployments (ops/rs.py gf_matmul)."""
+    kw.setdefault("platform", "cpu")
     seg_path = os.path.join(store_dir, seg_name)
     with open(seg_path, "rb") as f:
         raw = f.read()
@@ -140,6 +148,7 @@ def reconstruct_segment(store_dir: str, seg_name: str, **kw) -> bytes:
     if all(i in present for i in range(K)):
         data = np.stack([present[i] for i in range(K)])
     else:
+        kw.setdefault("platform", "cpu")  # see encode_segment
         data = np.asarray(rs_reconstruct(present, k=K, m=M, **kw))
     raw = data.reshape(-1).tobytes()[:orig_len]
     if (zlib.crc32(raw) & 0xFFFFFFFF) != data_crc:

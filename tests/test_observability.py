@@ -79,8 +79,17 @@ def test_admin_stats_surface():
 
 def test_admin_stats_shows_new_leader_after_broker_death():
     """VERDICT next-#6 'done' bar: a failover's new-leader election is
-    visible through admin.stats (leader moved, term bumped)."""
-    with InProcCluster(make_config(4)) as c:
+    visible through admin.stats (leader moved, term bumped).
+
+    Leaders collocate on the controller wherever its replica is
+    up-to-date (manager.plan_elections), so a non-controller leader —
+    the victim this test needs — only exists for partitions whose
+    replica set EXCLUDES the controller; enough partitions over 4
+    brokers at RF 3 guarantees at least one."""
+    from ripplemq_tpu.metadata.models import Topic
+
+    topics = (Topic("topic1", 4, 3), Topic("topic2", 2, 3))
+    with InProcCluster(make_config(4, topics=topics)) as c:
         c.wait_for_leaders()
         client = c.client()
         any_b = next(iter(c.brokers.values()))
