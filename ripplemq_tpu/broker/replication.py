@@ -107,6 +107,23 @@ class _Sender(threading.Thread):
             self._cond.notify()
         return fut
 
+    def cancel(self, fut: Future) -> bool:
+        """Remove a still-queued entry by its future (a timed-out read
+        barrier must not leave its batch behind: during a partition,
+        refused-and-retried reads would otherwise grow the queue without
+        bound, and a healed standby would have to drain the stale
+        backlog before any real round). Returns False if the entry
+        already left the queue (in flight or done) — those resolve into
+        an abandoned future, which is harmless."""
+        with self._cond:
+            for q in (self._queue, self._buffer if self._buffer is not None
+                      else []):
+                for i, (_, f) in enumerate(q):
+                    if f is fut:
+                        del q[i]
+                        return True
+        return False
+
     def begin_buffer(self) -> None:
         with self._cond:
             if self._buffer is None:
@@ -219,6 +236,11 @@ class RoundReplicator:
 
     def _sender(self, bid: int) -> _Sender:
         with self._lock:
+            if self._stopped:
+                # A racing caller (the read barrier fires from arbitrary
+                # RPC threads) must not resurrect sender threads after
+                # stop() — they would never be stopped again and leak.
+                raise ReplicationError("replicator stopped")
             s = self._senders.get(bid)
             if s is None:
                 s = _Sender(self, bid)
@@ -260,21 +282,36 @@ class RoundReplicator:
 
     # -- hot path (DataPlane resolver thread) --
 
-    def replicate(self, records: list) -> None:
+    def replicate(self, records: list,
+                  timeout_s: Optional[float] = None) -> None:
         """Block until every current-set member acked this round. Raises
         FencedError if deposed. A member removed from the set mid-wait is
         skipped; an unreachable member is flagged suspect (duty loop
-        proposes removal) while the wait continues."""
+        proposes removal) while the wait continues. `timeout_s` bounds
+        the whole wait (the resolver passes None — a settled round MUST
+        have every member's ack; the linearizable-read barrier passes a
+        bound, since an unconfirmable read should refuse, not hang)."""
         targets = set(self.members_fn())
         with self._lock:
             targets |= self._joining
-        futs = {bid: self._sender(bid).enqueue(records) for bid in targets}
+        senders = {bid: self._sender(bid) for bid in targets}
+        futs = {bid: s.enqueue(records) for bid, s in senders.items()}
         start = time.monotonic()
         for bid, fut in futs.items():
             suspected = False
             while True:
                 if bid not in self.members_fn():
                     break  # joiner or freshly-removed member: no ack needed
+                if (timeout_s is not None
+                        and time.monotonic() - start > timeout_s):
+                    # Withdraw every still-queued entry of this timed-out
+                    # round before refusing (see _Sender.cancel).
+                    for b, f in futs.items():
+                        if not f.done():
+                            senders[b].cancel(f)
+                    raise ReplicationError(
+                        f"standby {bid} unconfirmed after {timeout_s}s"
+                    )
                 try:
                     fut.result(timeout=0.05)
                     break

@@ -65,6 +65,53 @@ from ripplemq_tpu.wire.transport import (
 log = get_logger("broker")
 
 
+class _BarrierGate:
+    """Batched read-index barrier (SURVEY.md §7 "read semantics", the
+    read-index option). Callers block until a barrier that STARTED after
+    their arrival completes; concurrent callers share one barrier, so
+    the per-read cost under load is a fraction of one standby round
+    trip. `fire` confirms leadership — here, an empty epoch-fenced
+    record batch through the standby ack stream (a standby knowing a
+    newer epoch rejects it, a partitioned standby times it out; either
+    way the read REFUSES instead of serving a possibly-stale prefix)."""
+
+    def __init__(self, fire) -> None:
+        self._fire = fire
+        self._lock = threading.Lock()
+        self._pending = None  # Future whose fire has NOT started yet
+
+    def wait(self, timeout_s: float) -> None:
+        from concurrent.futures import Future
+
+        with self._lock:
+            fut = self._pending
+            if fut is None:
+                fut = self._pending = Future()
+                threading.Thread(
+                    target=self._run, args=(fut,), daemon=True,
+                    name="read-barrier",
+                ).start()
+        try:
+            fut.result(timeout=timeout_s)
+        except TimeoutError:
+            raise NotCommittedError(
+                "read barrier timed out: leadership unconfirmed"
+            ) from None
+
+    def _run(self, fut) -> None:
+        # Leave _pending BEFORE firing: a caller arriving after the fire
+        # began must wait for the NEXT barrier (its leadership proof
+        # must postdate the read's arrival).
+        with self._lock:
+            if self._pending is fut:
+                self._pending = None
+        try:
+            self._fire()
+            fut.set_result(True)
+        except Exception as e:
+            fut.set_exception(e)
+
+
 class BrokerServer:
     """One broker. `net` is an InProcNetwork for single-process clusters
     (tests, single-chip deployments) or None for real TCP sockets."""
@@ -234,6 +281,8 @@ class BrokerServer:
         # Repair-scan cadence (see _controller_duty): lag repair needs a
         # device fetch, so it must not ride every duty tick.
         self._last_repair_scan = 0.0
+        # Read-index barrier (linearizable_reads; see _BarrierGate).
+        self._barrier_gate = _BarrierGate(self._fire_read_barrier)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -929,10 +978,31 @@ class BrokerServer:
 
         return wait
 
+    def _read_barrier(self) -> None:
+        """linearizable_reads: confirm this broker still commands the
+        current controller epoch before serving committed data (off by
+        default — see ClusterConfig.linearizable_reads for semantics
+        and cost)."""
+        if not self.config.linearizable_reads:
+            return
+        self._barrier_gate.wait(
+            timeout_s=min(5.0, self.config.rpc_timeout_s)
+        )
+
+    def _fire_read_barrier(self) -> None:
+        rep = self._replicator
+        if rep is None:
+            # No standby stream configured (standby_count 0): controller
+            # failover is disabled, so no newer epoch can exist to fence
+            # against — the local engine is trivially current.
+            return
+        rep.replicate([], timeout_s=min(2.0, self.config.rpc_timeout_s))
+
     def _engine_read(self, slot: int, offset: int, replica: int,
                      max_msgs: Optional[int] = None):
         dp = self._local_engine()
         if dp is not None:
+            self._read_barrier()
             return dp.read(slot, offset, replica, max_msgs)
         resp = self._engine_call(
             {"type": "engine.read", "slot": slot, "offset": offset,
@@ -974,6 +1044,7 @@ class BrokerServer:
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
         if t == "engine.read":
+            self._read_barrier()
             limit = req.get("max_msgs")
             msgs, end = dp.read(
                 int(req["slot"]), int(req["offset"]), int(req["replica"]),

@@ -63,6 +63,18 @@ class ClusterConfig:
     # record). None = unlimited — the default, and strictly more than
     # the reference retains (its partition state is JVM-heap-bounded).
     store_retention_bytes: int | None = None
+    # Linearizable reads (off by default — the reference serves
+    # leader-local reads with no bound at all,
+    # PartitionStateMachine.java:85-110, and the default here is already
+    # stricter: commit-bounded). When on, every consume first confirms
+    # the controller's epoch through the standby ack stream (an empty
+    # epoch-fenced record batch; broker/server.py _BarrierGate), closing
+    # the one remaining anomaly: a deposed-but-partitioned controller
+    # serving an old-but-committed prefix while a promoted standby
+    # accepts newer writes. Cost: up to one standby-set round trip per
+    # read BATCH (concurrent readers share one barrier; an
+    # unconfirmable read refuses with not_committed instead of serving).
+    linearizable_reads: bool = False
     # RPC worker pool per broker. A produce/engine.append handler BLOCKS
     # its worker until the round commits, so this caps a broker's
     # in-flight appends — size it to the offered concurrency (threads
@@ -171,6 +183,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["standby_count"] = int(raw["standby_count"])
     if "rpc_workers" in raw:
         extra["rpc_workers"] = int(raw["rpc_workers"])
+    if "linearizable_reads" in raw:
+        extra["linearizable_reads"] = bool(raw["linearizable_reads"])
     if "segment_bytes" in raw:
         extra["segment_bytes"] = int(raw["segment_bytes"])
     if raw.get("store_retention_bytes") is not None:
