@@ -37,18 +37,48 @@ def make_config(n_brokers=3, topics=None, engine=None, **kw) -> ClusterConfig:
 
 
 class InProcCluster:
-    def __init__(self, config: ClusterConfig | None = None, n_brokers=3):
+    def __init__(self, config: ClusterConfig | None = None, n_brokers=3,
+                 data_dir=None):
+        """`data_dir`: optional root for per-broker durable stores
+        (<data_dir>/broker-<id>); enables restart-with-recovery (the
+        randomized soak's kill/restart schedule)."""
         self.config = config or make_config(n_brokers)
         self.net = InProcNetwork()
+        self._data_dir = data_dir
         self.brokers: dict[int, BrokerServer] = {}
         for b in self.config.brokers:
-            self.brokers[b.broker_id] = BrokerServer(
-                b.broker_id,
-                self.config,
-                net=self.net,
-                tick_interval_s=0.02,
-                duty_interval_s=0.05,
-            )
+            self.brokers[b.broker_id] = self._make(b.broker_id)
+
+    def _make(self, broker_id: int) -> BrokerServer:
+        data_dir = None
+        if self._data_dir is not None:
+            import os
+
+            data_dir = os.path.join(str(self._data_dir),
+                                    f"broker-{broker_id}")
+        return BrokerServer(
+            broker_id,
+            self.config,
+            net=self.net,
+            tick_interval_s=0.02,
+            duty_interval_s=0.05,
+            data_dir=data_dir,
+        )
+
+    def kill(self, broker_id: int) -> None:
+        """Hard-kill one broker: unreachable AND stopped (its durable
+        state, if any, survives for restart)."""
+        self.net.set_down(self.brokers[broker_id].addr)
+        self.brokers[broker_id].stop()
+
+    def restart(self, broker_id: int) -> BrokerServer:
+        """Boot a fresh process-equivalent for a killed broker (recovers
+        from its data_dir when the cluster has one)."""
+        self.net.set_up(self.brokers[broker_id].addr)
+        b = self._make(broker_id)
+        self.brokers[broker_id] = b
+        b.start()
+        return b
 
     def start(self) -> None:
         for b in self.brokers.values():
