@@ -75,10 +75,15 @@ def _produce(c, client, topic, pid, payload, dead=(), timeout=60.0,
     raise AssertionError(f"produce never succeeded: {last}")
 
 
-def _drain(c, client, topic, pid, consumer, dead=()):
+def _drain(c, client, topic, pid, consumer, dead=(), deadline_s=120.0):
     got: list[bytes] = []
     quiet = 0
+    deadline = time.time() + deadline_s
     while quiet < 40:
+        assert time.time() < deadline, (
+            f"drain of {topic}[{pid}] stuck after {deadline_s}s "
+            f"({len(got)} messages so far)"
+        )
         live = [b for i, b in c.brokers.items() if i not in dead]
         leader = live[0].manager.leader_of((topic, pid))
         if leader is None or leader in dead:

@@ -32,9 +32,13 @@ from tests.test_soak import _drain, _produce, wait_until
 
 
 def _live_controller(c, dead):
-    views = [b.manager.current_controller()
-             for i, b in c.brokers.items() if i not in dead]
-    return views[0] if views else None
+    """The agreed controller across live brokers, or None while their
+    views still diverge (a heal gate passing on one broker's view would
+    let the next fault round select victims from a cluster not yet in
+    the state the gate claims)."""
+    views = {b.manager.current_controller()
+             for i, b in c.brokers.items() if i not in dead}
+    return views.pop() if len(views) == 1 else None
 
 
 def _cluster_healthy(c):
