@@ -1,0 +1,60 @@
+"""The README's front-page performance figures must quote the NEWEST
+BENCH_r*.json artifact exactly (VERDICT r3 weak-#4: the front page
+drifted from the measured record across commits). The pin is the same
+philosophy as test_packaging.py's compose-topology pin: a doc that can
+disagree with an artifact eventually will, unless a test fails when it
+does."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _newest_artifact():
+    arts = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert arts, "no BENCH_r*.json artifacts found"
+    return arts[-1]
+
+
+def test_readme_quotes_newest_bench_artifact_exactly():
+    path = _newest_artifact()
+    name = os.path.basename(path)
+    with open(path) as f:
+        rec = json.load(f)
+    data = rec.get("parsed") or rec
+    readme = open(os.path.join(REPO, "README.md")).read()
+
+    line = re.search(r"Latest recorded \(([^)]+)\):(.*?)\n\n", readme,
+                     re.DOTALL)
+    assert line, "README lost its 'Latest recorded (BENCH_r*.json)' figures"
+    assert line.group(1) == name, (
+        f"README quotes {line.group(1)} but the newest artifact is {name}: "
+        f"update the front-page figures"
+    )
+    quoted = line.group(2)
+
+    expect = {
+        f"{data['value'] / 1e6:.2f}M committed appends": "engine number",
+        f"vs_baseline {data['vs_baseline']}x": "baseline ratio",
+        f"p50 ack {data['p50_ack_ms']} ms": "ack latency",
+        f"{data['round_rtt_ms']}\nms single-round RTT".replace("\n", " "):
+            "round RTT",
+        f"consume {data['consume_msgs_per_sec']} msgs/s": "consume rate",
+    }
+    for needle, label in expect.items():
+        assert needle in quoted.replace("\n", " "), (
+            f"README's {label} disagrees with {name}: expected {needle!r} "
+            f"in {quoted!r}"
+        )
+
+    # Round-4+ artifacts carry the end-to-end system number; once
+    # recorded, the front page must quote it too.
+    if "e2e_appends_per_sec" in data:
+        assert f"end-to-end {data['e2e_appends_per_sec']}" in readme.replace(
+            ",", ""
+        ), f"README must quote {name}'s e2e_appends_per_sec"
