@@ -451,6 +451,10 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
         "rpc_timeout_s": 60.0,   # a queued append must outlive a backlog
         "rpc_workers": 64,       # workers block on round futures (see
                                  # ClusterConfig.rpc_workers)
+        # Throughput operating point (the operating_curve documents the
+        # latency cost): gather ~coalesce_s of burst per dispatch, since
+        # each launch costs ~11 ms through the tunnel (PROFILE.md).
+        "coalesce_s": 0.01,
     }
     tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
     config = parse_cluster_config(raw)

@@ -1154,6 +1154,23 @@ class DataPlane:
             a *= 4
         return max(1, min(a, self.cfg.partitions))
 
+    def all_buckets(self) -> tuple[int, ...]:
+        """Every active-set bucket this shape can hit — the boot-time
+        warm list (a bucket first reached under traffic charges its
+        multi-second XLA compile to live produces; measured as
+        multi-second dead zones in the e2e bench before full warming).
+        Derived FROM _active_bucket so the ladder geometry lives in one
+        place: sweep n over doubling active counts up to P and collect
+        the buckets they map to."""
+        P = self.cfg.partitions
+        out = []
+        n = 1
+        while n < P:
+            out.append(self._active_bucket(n))
+            n *= 2
+        out.append(self._active_bucket(P))
+        return tuple(dict.fromkeys(out))
+
     def _build_round_locked(self, pred_end: dict[int, int]):
         """Build ONE round from the queues (caller holds self._lock).
         `pred_end` carries the chain's predicted per-slot log ends —

@@ -319,6 +319,9 @@ class BrokerServer:
             self.config.engine, mode=self._engine_mode,
             store=self._round_store,
             workers=self._engine_workers or None,
+            coalesce_s=self.config.coalesce_s,
+            chain_depth=self.config.chain_depth,
+            pipeline_depth=self.config.pipeline_depth,
         )
         if image is not None:
             dp.install(image)
@@ -329,11 +332,14 @@ class BrokerServer:
         self.manager.attach_dataplane(dp)
         if self._started:
             dp.start()
-        # Compile hot programs before traffic needs them. On TAKEOVER
+        # Compile hot programs before traffic needs them — EVERY bucket
+        # this shape can hit, or the first big produce wave charges a
+        # multi-second XLA compile to live traffic. On TAKEOVER
         # (epoch > 0) the first election pass is the latency-critical
         # device work — let it win the lock race before warming.
         dp.warm_async(
-            delay_s=2.0 if self.manager.current_epoch() > 0 else 0.0
+            buckets=dp.all_buckets(),
+            delay_s=2.0 if self.manager.current_epoch() > 0 else 0.0,
         )
 
     def _make_replicator(self):

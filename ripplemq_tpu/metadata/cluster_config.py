@@ -63,6 +63,18 @@ class ClusterConfig:
     # record). None = unlimited — the default, and strictly more than
     # the reference retains (its partition state is JVM-heap-bounded).
     store_retention_bytes: int | None = None
+    # Batcher operating point (see the bench's operating_curve for the
+    # measured latency/throughput tradeoff of these knobs; defaults
+    # favour ack latency):
+    # - coalesce_s: how long the step thread gathers a burst before
+    #   dispatching a round (each dispatch costs a host-device launch).
+    # - chain_depth: complete quorum rounds per device launch for deep
+    #   backlogs (lax.scan; amortizes the launch).
+    # - pipeline_depth: outstanding launches before dispatch
+    #   backpressures.
+    coalesce_s: float = 0.002
+    chain_depth: int = 4
+    pipeline_depth: int = 8
     # Linearizable reads (off by default — the reference serves
     # leader-local reads with no bound at all,
     # PartitionStateMachine.java:85-110, and the default here is already
@@ -185,6 +197,12 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["rpc_workers"] = int(raw["rpc_workers"])
     if "linearizable_reads" in raw:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
+    if "coalesce_s" in raw:
+        extra["coalesce_s"] = float(raw["coalesce_s"])
+    if "chain_depth" in raw:
+        extra["chain_depth"] = int(raw["chain_depth"])
+    if "pipeline_depth" in raw:
+        extra["pipeline_depth"] = int(raw["pipeline_depth"])
     if "segment_bytes" in raw:
         extra["segment_bytes"] = int(raw["segment_bytes"])
     if raw.get("store_retention_bytes") is not None:
