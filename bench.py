@@ -495,7 +495,9 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
 
         # Compile every active-set bucket the wave can hit, then warm
         # the client path (connections + metadata) once.
-        controller.dataplane.warm(buckets=(8, 32, 128, 512, 1024))
+        controller.dataplane.warm(
+            buckets=controller.dataplane.all_buckets()
+        )
         pc = ProducerClient(bootstrap, rpc_timeout_s=120.0)
         pc.produce_batch("bench", [b"e2e-warmup"] * 8)
 

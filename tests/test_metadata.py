@@ -207,3 +207,31 @@ def test_parse_cluster_config_both_schemas():
     assert cfg.engine.partitions == 5  # sum of topic partitions
     assert cfg.engine.replicas == 2
     assert cfg.topics[0].replication_factor == 2
+
+
+def test_parse_cluster_config_operational_knobs():
+    """Round-4 knobs reach the config value (and default sanely): the
+    batcher operating point, RPC worker pool, and linearizable reads."""
+    raw = {
+        "brokers": [{"id": 0, "host": "h", "port": 1}],
+        "topics": [{"name": "t", "partitions": 1, "replication_factor": 1}],
+        "coalesce_s": 0.01,
+        "chain_depth": 8,
+        "pipeline_depth": 16,
+        "rpc_workers": 128,
+        "linearizable_reads": True,
+    }
+    cfg = parse_cluster_config(raw)
+    assert cfg.coalesce_s == 0.01
+    assert cfg.chain_depth == 8
+    assert cfg.pipeline_depth == 16
+    assert cfg.rpc_workers == 128
+    assert cfg.linearizable_reads is True
+    defaults = parse_cluster_config(
+        {"brokers": raw["brokers"], "topics": raw["topics"]}
+    )
+    assert defaults.coalesce_s == 0.002
+    assert defaults.chain_depth == 4
+    assert defaults.pipeline_depth == 8
+    assert defaults.rpc_workers == 16
+    assert defaults.linearizable_reads is False

@@ -21,7 +21,9 @@ def _newest_artifact():
     # Numeric round order: lexicographic sort would pin r100 below r99
     # (or misorder an unpadded r4), silently re-allowing the drift this
     # test exists to catch.
-    return max(arts, key=lambda p: int(re.search(r"r(\d+)", p).group(1)))
+    return max(arts, key=lambda p: int(
+        re.search(r"BENCH_r(\d+)", os.path.basename(p)).group(1)
+    ))
 
 
 def test_readme_quotes_newest_bench_artifact_exactly():
