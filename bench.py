@@ -648,11 +648,15 @@ def main() -> None:
     lat = _run_latency(lat_cfg)
     rtt_ms = _round_rtt(lat_cfg)
     curve = _run_curve(lat_cfg)
+    # read_batch 128: the host-mirror consume path serves up to
+    # read_batch rows per call, so bigger windows amortize the per-call
+    # (lock + decode dispatch) overhead — the consumer-side analogue of
+    # producer batching.
     consume_cfg = EngineConfig(
         partitions=1024, replicas=5, slots=2048, slot_bytes=128,
-        max_batch=32, read_batch=64, max_consumers=64, max_offset_updates=8,
+        max_batch=32, read_batch=128, max_consumers=64, max_offset_updates=8,
     )
-    consume_rate = _run_consume(consume_cfg, consumers=32)
+    consume_rate = _run_consume(consume_cfg, consumers=32, rows_per_part=128)
     spmd = _run_spmd_parity()
     e2e = _run_e2e()
 
