@@ -396,7 +396,7 @@ def _run_spmd_parity(rounds: int = 64) -> dict:
 
 
 def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
-             threads: int = 8, batch: int = 256, window: int = 4) -> dict:
+             threads: int = 8, batch: int = 256, window: int = 8) -> dict:
     """END-TO-END produce throughput: fresh, distinct payloads streamed
     by real producer clients through TCP sockets, broker dispatch, the
     DataPlane batcher, device quorum rounds, the round store, AND the
