@@ -6,8 +6,10 @@ aggressively-GC'd store (tiny segments + retention cap) under kill/
 restart faults. The invariant under GC is WEAKER by design — consumers
 below the retention floor earliest-reset forward — so the check is:
 
-1. every drain is an ORDERED, duplicate-free subsequence of the acked
-   sequence (no reordering, no corruption, no replay);
+1. every drain is an ORDERED subsequence of the acked sequence in
+   first-occurrence terms (no reordering, no corruption; duplicates are
+   TOLERATED — the broker is at-least-once like the reference, and a
+   client retry after a mid-kill ack loss legitimately double-commits);
 2. once the floor QUIESCES (no appends + equal consecutive floor
    observations), a fresh consumer's drain is a CONTIGUOUS SUFFIX of
    the acked sequence — nothing above the floor is missing.
@@ -133,7 +135,8 @@ def test_gc_churn_with_failover(seed, tmp_path):
             t.join(timeout=120)
             assert not t.is_alive()
 
-        # Invariant 1 under live GC: ordered, duplicate-free subsequence.
+        # Invariant 1 under live GC: ordered subsequence (first-occurrence
+        # terms; duplicates tolerated — see module docstring).
         for pid in (0, 1):
             got = _drain(c, client, "t", pid, f"live-{pid}")
             sset = set(acked[pid])
