@@ -54,8 +54,15 @@ def _cluster_healthy(c):
     return all(c._all_leaders_known(b) for b in c.brokers.values())
 
 
-@pytest.mark.parametrize("seed", [11, 23, 37, 41, 53])
-def test_randomized_fault_schedule(seed, tmp_path):
+@pytest.mark.parametrize("seed,linearizable", [
+    (11, False), (23, False), (37, False), (41, False), (53, False),
+    # One schedule with the read-index barrier ON: consumes prove the
+    # controller epoch through the standby ack stream, so every fault
+    # round also exercises barrier x failover interleavings (refusals
+    # during churn are retried by the drain helpers).
+    (61, True),
+])
+def test_randomized_fault_schedule(seed, linearizable, tmp_path):
     rng = random.Random(seed)
     config = make_config(
         n_brokers=4,
@@ -64,6 +71,7 @@ def test_randomized_fault_schedule(seed, tmp_path):
         # store and lagging drains hit the store-served path.
         engine=small_cfg(partitions=2, replicas=3, slots=64, max_batch=8),
         standby_count=2,
+        linearizable_reads=linearizable,
     )
     acked: list[bytes] = []
     dead: set[int] = set()
