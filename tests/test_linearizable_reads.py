@@ -46,22 +46,51 @@ def _partition_away(c, victim: int) -> None:
             c.net.block(c.brokers[victim].addr, b.addr)
 
 
+def _controller_led_partition(c, ctrl):
+    """Pick a partition whose LEADER is the controller broker. The final
+    stale read must be served by a broker that is both the deposed
+    controller and the partition's leader — reading any other partition
+    through the controller draws a correct (and test-breaking)
+    `not_leader` refusal. plan_elections' collocation preference applies
+    only on log-end ties (manager.py plan_elections), so partition 0's
+    leader can legitimately land elsewhere: select by observed leadership
+    instead of assuming it (r4 flake)."""
+    n_parts = next(t for t in c.config.topics if t.name == "t").partitions
+    found = []
+
+    def find():
+        mgr = c.brokers[ctrl].manager
+        for p in range(n_parts):
+            if mgr.leader_of(("t", p)) == ctrl:
+                found.append(p)
+                return True
+        return False
+
+    assert wait_until(find), (
+        "no partition elected the controller as leader — with empty logs "
+        "every election is a tie and the collocation preference should "
+        "have placed one here"
+    )
+    return found[0]
+
+
 def _stage_stale_controller(c):
     """Partition the controller away, wait for a standby's promotion,
     and land one post-promotion append the old controller cannot know
-    about. Returns (old controller id, its pre-partition messages)."""
+    about. Returns (old controller id, client, staged partition id)."""
     _wait_standbys(c, 2)
+    c.wait_for_leaders()
     ctrl = c.config.controller
+    pid = _controller_led_partition(c, ctrl)
     client = c.client()
     for i in range(4):
-        _produce(c, client, "t", 0, b"pre-%d" % i)
+        _produce(c, client, "t", pid, b"pre-%d" % i)
     # Register the checking consumer while metadata is reachable —
     # name→slot binding is replicated metadata, and the partitioned
     # controller cannot register new names.
-    leader = c.brokers[ctrl].manager.leader_of(("t", 0))
     reg = client.call(
-        c.brokers[leader].addr,
-        {"type": "consume", "topic": "t", "partition": 0,
+        c.brokers[ctrl].addr,
+        {"type": "consume", "topic": "t", "partition": pid,
          "consumer": "lin-check", "max_messages": 0},
         timeout=10.0,
     )
@@ -72,12 +101,12 @@ def _stage_stale_controller(c):
     ), "controller never moved"
     new_ctrl = _any_survivor(c, {ctrl}).manager.current_controller()
     assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None)
-    _produce(c, client, "t", 0, b"post-promotion", dead={ctrl})
+    _produce(c, client, "t", pid, b"post-promotion", dead={ctrl})
     # The old controller is still unaware (its fence duty can't learn the
     # new epoch through the partition) and still holds a device program.
     assert c.brokers[ctrl].dataplane is not None
     assert c.brokers[ctrl].manager.current_controller() == ctrl
-    return ctrl, client
+    return ctrl, client, pid
 
 
 @pytest.mark.parametrize("linearizable", [False, True])
@@ -88,10 +117,10 @@ def test_stale_controller_read(linearizable):
     cannot confirm the epoch through the partition and the read REFUSES
     with a retryable not_committed error instead of serving."""
     with _make_cluster(linearizable) as c:
-        ctrl, client = _stage_stale_controller(c)
+        ctrl, client, pid = _stage_stale_controller(c)
         resp = client.call(
             c.brokers[ctrl].addr,
-            {"type": "consume", "topic": "t", "partition": 0,
+            {"type": "consume", "topic": "t", "partition": pid,
              "consumer": "lin-check"},
             timeout=10.0,
         )
