@@ -22,7 +22,10 @@ from ripplemq_tpu.broker.dataplane import (
     NotCommittedError,
     PartitionFullError,
 )
-from ripplemq_tpu.broker.manager import PartitionManager
+from ripplemq_tpu.broker.manager import (
+    ConsumerTableFullError,
+    PartitionManager,
+)
 from ripplemq_tpu.broker.server import BrokerServer
 
 __all__ = [
@@ -31,6 +34,7 @@ __all__ = [
     "DataPlane",
     "NotCommittedError",
     "PartitionFullError",
+    "ConsumerTableFullError",
     "PartitionManager",
     "BrokerServer",
 ]

@@ -187,6 +187,14 @@ class DataPlane:
             if host_read_cache else None
         )
         self._cache_end = np.zeros((P0,), np.int64)
+        # Post-gap mirrored run per slot: after a resolve failure leaves
+        # a mirror gap, later rounds still write their rows physically —
+        # only `_cache_end` stops advancing. `slot → [run_base, run_end]`
+        # tracks that contiguous post-gap run so the cache can HEAL once
+        # the trim watermark passes run_base (everything unmirrored below
+        # it is then store-served and never consults the mirror), rather
+        # than staying disabled for the slot's lifetime.
+        self._mirror_gap: dict[int, list[int]] = {}
         # Persisted prefix per partition: rows below this are in the
         # ROUND STORE (appended; flush may lag by flush_interval_s).
         # Advanced by _persist_round only after the store append
@@ -1223,7 +1231,7 @@ class DataPlane:
             if can_trim:
                 # Lazy retention: raise the trim watermark just enough
                 # for a full window past the current end — but never
-                # above the PERSISTED prefix (self._log_end). `end` may
+                # above the PERSISTED prefix (self._persisted). `end` may
                 # be chain-predicted rounds ahead of what the resolver
                 # has persisted; an unclamped raise could let a
                 # concurrent read find nothing in the store below the
@@ -1487,17 +1495,29 @@ class DataPlane:
             self._host_ring[slot, pos : pos + rows.shape[0]] = rows
             with self._lock:
                 new_end = base + rows.shape[0]
-                # Contiguous-prefix advance — OR gap healing: once the
-                # trim watermark reaches this round's base, everything
-                # unmirrored sits below trim (store-served; reads never
-                # consult the mirror there), so the mirror is valid
-                # again from `base` and the cache need not stay disabled
-                # for the slot's lifetime after one resolve failure.
-                if (self._cache_end[slot] >= base
-                        or int(self.trim[slot]) >= base):
+                if self._cache_end[slot] >= base:
                     self._cache_end[slot] = max(
                         new_end, int(self._cache_end[slot])
                     )
+                    continue
+                # Mirror gap (an earlier round's resolve failed before
+                # mirroring): keep writing and track the contiguous
+                # POST-GAP run. Heal when trim passes the run's base:
+                # every unmirrored row then sits below trim (store
+                # -served; mirror-eligible reads are all >= trim), so
+                # the mirror is valid again from run_base to run_end.
+                # Comparing trim against the run base — not this
+                # record's `base`, which tracks the advancing log end
+                # and stays forever ahead of trim — is what lets the
+                # heal actually fire (r4 advisor).
+                g = self._mirror_gap.get(slot)
+                if g is None or base > g[1]:
+                    g = self._mirror_gap[slot] = [base, new_end]
+                else:
+                    g[1] = max(g[1], new_end)
+                if int(self.trim[slot]) >= g[0]:
+                    self._cache_end[slot] = g[1]
+                    del self._mirror_gap[slot]
 
     def _round_records(self, rc: dict, committed
                        ) -> list[tuple[int, int, int, bytes]]:
@@ -1561,6 +1581,7 @@ class DataPlane:
                     image.log_data, np.uint8
                 )[:, : self.cfg.slots]
                 self._cache_end = ends.copy()
+                self._mirror_gap.clear()
             self.trim = np.maximum(0, ends - self.cfg.slots)
             self._scan_index = None  # history may differ on this store
             self._offsets_shadow = np.asarray(image.offsets, np.int32).copy()

@@ -46,6 +46,14 @@ from ripplemq_tpu.metadata.models import (
     topics_to_wire,
 )
 
+class ConsumerTableFullError(Exception):
+    """All `max_consumers` device-table slots are bound to names. The
+    reference's consumerOffsets map grows without bound and never refuses
+    (PartitionStateMachine.java:27); this framework's table is a fixed
+    [P, C] device tensor, so the refusal must exist — and must surface as
+    a typed, client-distinguishable error rather than `internal:`."""
+
+
 # Metadata-plane command ops (the hostraft log's vocabulary).
 OP_SET_TOPICS = "set_topics"
 OP_SET_LEADER = "set_leader"
@@ -406,7 +414,9 @@ class PartitionManager:
             for s in range(C):
                 if s not in used:
                     return s
-            raise RuntimeError(f"consumer table full ({C} slots)")
+            raise ConsumerTableFullError(
+                f"consumer table full ({C} slots in use)"
+            )
 
     # ------------------------------------------- cluster-leader duty logic
 
@@ -504,9 +514,18 @@ class PartitionManager:
             for t in self.topics:
                 quorum = t.replication_factor // 2 + 1
                 for a in t.assignments:
-                    if a.leader is not None and a.leader in live:
-                        continue
                     slot = self.slot_map.get((t.name, a.partition_id))
+                    if a.leader is not None and a.leader in live:
+                        # Clear the debounce stamp HERE, where healthy
+                        # leadership is observed every duty tick — not
+                        # only in plan_elections, which no longer runs on
+                        # healthy clusters (this pre-check exists to skip
+                        # it). A stale stamp from a previous outage would
+                        # otherwise void the debounce window for the next
+                        # one (r4 advisor).
+                        if slot is not None:
+                            self._leaderless_since.pop(slot, None)
+                        continue
                     if slot is None:
                         continue
                     since = self._leaderless_since.get(slot)

@@ -49,6 +49,7 @@ from ripplemq_tpu.broker.manager import (
     OP_BATCH,
     OP_REGISTER_CONSUMER,
     OP_SET_STANDBYS,
+    ConsumerTableFullError,
     PartitionManager,
 )
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
@@ -435,6 +436,11 @@ class BrokerServer:
             return {"ok": False, "error": f"unknown request type {t!r}"}
         except NotCommittedError as e:
             return {"ok": False, "error": f"not_committed: {e}"}
+        except ConsumerTableFullError as e:
+            # Permanent refusal, NOT retryable (not_committed implies
+            # retry): the client must pick a committed-and-released name
+            # or the operator must raise max_consumers.
+            return {"ok": False, "error": f"consumer_table_full: {e}"}
         except (KeyError, ValueError, TypeError) as e:
             return {"ok": False, "error": f"bad_request: {type(e).__name__}: {e}"}
 
@@ -490,6 +496,10 @@ class BrokerServer:
                 "read_queries": dp.read_queries,
                 "read_dispatches": dp.read_dispatches,
                 "read_cache_hits": dp.read_cache_hits,
+                # Slots whose host mirror is gap-disabled (resolve
+                # failure; pending trim-passage heal) — a silent cache
+                # regression the operator should be able to see.
+                "mirror_gap_slots": len(dp._mirror_gap),
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
                 "partitions": dp.cfg.partitions,
@@ -942,6 +952,19 @@ class BrokerServer:
             if slot is not None:
                 return slot
             time.sleep(0.01)
+        # Concurrent registrations can fill the table between this
+        # broker's pre-proposal slot pick and the replicated apply, which
+        # then drops the command (manager._apply_register_consumer); probe
+        # fullness so that race surfaces as the same typed refusal as the
+        # pre-proposal check instead of a generic registration timeout.
+        # Re-check the name first: ITS OWN apply may have landed just
+        # past the poll deadline, and a filled table must not turn a
+        # successful registration into a (permanent, non-retryable)
+        # refusal.
+        slot = self.manager.consumer_slot(consumer)
+        if slot is not None:
+            return slot
+        self.manager.next_consumer_slot()
         return None
 
     # -- engine access (direct on the controller, RPC from peers) ---------

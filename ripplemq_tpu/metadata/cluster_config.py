@@ -113,6 +113,19 @@ class ClusterConfig:
                 "store_retention_bytes must be at least 2x segment_bytes "
                 "(one sealed + one active segment)"
             )
+        if self.linearizable_reads and self.standby_count < 1:
+            # The read barrier proves the controller's epoch through the
+            # standby ack stream; with no standbys there is no stream to
+            # prove through (and no failover, so the anomaly the flag
+            # closes cannot occur). The barrier would silently no-op
+            # (BrokerServer._fire_read_barrier) — make the contract
+            # explicit at parse time instead.
+            raise ValueError(
+                "linearizable_reads requires standby_count >= 1: the read "
+                "barrier confirms the controller epoch through the standby "
+                "ack stream (with standby_count=0 there is no controller "
+                "failover and commit-bounded reads are already linearizable)"
+            )
 
     @property
     def controller(self) -> int:

@@ -258,3 +258,39 @@ def test_consume_max_messages_zero_returns_none(cluster):
                 {"type": "consume", "topic": "topic1", "partition": 0,
                  "consumer": "probe", "max_messages": 0})
     assert resp["ok"] and resp["messages"] == []
+
+
+def test_consumer_table_full_is_typed_refusal():
+    """The [P, C] offset table is a fixed device tensor; the C+1'th
+    consumer name must draw a clean `consumer_table_full` refusal, not
+    `internal: RuntimeError` (the reference's unbounded consumerOffsets
+    map, PartitionStateMachine.java:27, never refuses — a bounded table
+    must refuse WELL). Fresh cluster: registrations fill the shared
+    table, which would starve the module-scoped cluster's other tests."""
+    from ripplemq_tpu.metadata.models import Topic
+    from tests.helpers import small_cfg
+
+    config = make_config(
+        n_brokers=3,
+        topics=(Topic("t", 1, 3),),
+        engine=small_cfg(partitions=1, max_consumers=4),
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        leader = c.leader_broker("t", 0)
+        for i in range(4):
+            resp = call(c, leader.addr,
+                        {"type": "consume", "topic": "t", "partition": 0,
+                         "consumer": f"full-{i}", "max_messages": 0})
+            assert resp["ok"], resp
+        resp = call(c, leader.addr,
+                    {"type": "consume", "topic": "t", "partition": 0,
+                     "consumer": "full-overflow", "max_messages": 0})
+        assert not resp["ok"], resp
+        assert resp["error"].startswith("consumer_table_full"), resp
+        assert "internal" not in resp["error"], resp
+        # Registered names keep working at the full table.
+        resp = call(c, leader.addr,
+                    {"type": "consume", "topic": "t", "partition": 0,
+                     "consumer": "full-0"})
+        assert resp["ok"], resp

@@ -144,6 +144,49 @@ def test_mirror_gap_falls_back_to_device():
         dp.stop()
 
 
+def test_mirror_gap_heals_after_trim_passes():
+    """A mirror gap must not disable the cache for the slot's lifetime:
+    later rounds still write their rows physically, and once trim passes
+    the post-gap run's base every unmirrored row is store-served — the
+    cache heals and hot reads stop dispatching (r4 advisor: the old heal
+    condition compared trim against each NEW round's base, which tracks
+    the advancing log end and never fires)."""
+    cfg = small_cfg(partitions=1, slots=128, max_batch=8, read_batch=8)
+    dp = _mk(cfg)
+    try:
+        for i in range(4):
+            dp.submit_append(
+                0, [b"pre-%d-%d" % (i, j) for j in range(4)]
+            ).result(timeout=30)
+        with dp._lock:
+            dp._cache_end[0] = 8  # simulate a resolve failure at row 8
+        sent = []
+        for i in range(60):
+            batch = [b"heal-%03d-%d" % (i, j) for j in range(4)]
+            sent.extend(batch)
+            dp.submit_append(0, batch).result(timeout=30)
+        with dp._lock:
+            assert 0 not in dp._mirror_gap, "gap never healed"
+            assert int(dp._cache_end[0]) == int(dp._log_end[0])
+            trim = int(dp.trim[0])
+        assert trim > 8, "test never advanced trim past the gap"
+        # Hot reads (>= trim) are cache-served again, and serve the
+        # right bytes.
+        hits0, disp0 = dp.read_cache_hits, dp.read_dispatches
+        got, offset = [], trim
+        while True:
+            msgs, nxt = dp.read(0, offset, replica=0)
+            if nxt == offset:
+                break
+            got.extend(msgs)
+            offset = nxt
+        assert dp.read_dispatches == disp0, "healed reads still dispatched"
+        assert dp.read_cache_hits > hits0
+        assert got and got == sent[-len(got):]
+    finally:
+        dp.stop()
+
+
 def test_mirror_seeded_by_recovery():
     """install() seeds the mirror from the replayed image: post-recovery
     hot reads are host-served immediately."""
