@@ -957,14 +957,20 @@ class BrokerServer:
         # then drops the command (manager._apply_register_consumer); probe
         # fullness so that race surfaces as the same typed refusal as the
         # pre-proposal check instead of a generic registration timeout.
-        # Re-check the name first: ITS OWN apply may have landed just
-        # past the poll deadline, and a filled table must not turn a
-        # successful registration into a (permanent, non-retryable)
-        # refusal.
+        # Re-check the name on BOTH sides of the probe: its own apply may
+        # land just past the poll deadline (even filling the table), and
+        # a successful registration must never surface as the permanent,
+        # non-retryable refusal.
         slot = self.manager.consumer_slot(consumer)
         if slot is not None:
             return slot
-        self.manager.next_consumer_slot()
+        try:
+            self.manager.next_consumer_slot()
+        except ConsumerTableFullError:
+            slot = self.manager.consumer_slot(consumer)
+            if slot is not None:
+                return slot
+            raise
         return None
 
     # -- engine access (direct on the controller, RPC from peers) ---------
