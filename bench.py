@@ -39,7 +39,9 @@ chip/link — the floor any ack latency pays; read the percentiles against
 it (behind the axon tunnel the RTT is ~200 ms; on an attached chip it is
 milliseconds). `baseline_appends_per_sec` is the absolute denominator of
 `vs_baseline`, recorded so the ratio is auditable from this artifact
-alone.
+alone; numerator and denominator are measured with the SAME sustained
+method (a methodology switch on one side would silently change the
+ratio's meaning across rounds).
 
 What is measured (BASELINE.md metric: committed-appends/sec/chip on a
 5-replica partition, 1k-partition fan-out config; p99 ack alongside):
@@ -100,12 +102,33 @@ def _make(cfg):
     return fns, alive, quorum, build_step_input
 
 
+def _read_and_check(cfg, fns, state, replica: int, p: int, offset: int,
+                    batch: int, where: str) -> None:
+    """Walk the read window from `offset` until `batch` messages arrived
+    and byte-compare each against PAYLOAD (shared by the burst-window
+    and sustained verifiers — one read-walk implementation to fix)."""
+    from ripplemq_tpu.core.encode import decode_entries
+
+    msgs: list[bytes] = []
+    while len(msgs) < batch:  # reads window read_batch rows
+        data, lens, count = fns.read(
+            state, np.int32(replica), np.int32(p), np.int32(offset)
+        )
+        got = decode_entries(data, lens, count)
+        assert got, f"readback {where}: {len(msgs)} of {batch} messages"
+        msgs.extend(got)
+        offset += int(count)
+    for m in msgs[:batch]:
+        assert m == PAYLOAD, (
+            f"readback {where}: corrupt payload {m[:24]!r}..."
+        )
+
+
 def _verify_readback(cfg, fns, state, rounds: int, batch: int) -> None:
     """Byte-compare a sample of appended payloads across partitions,
     rounds, and replicas (rounds advance the log by ALIGN-padded windows
     from a fresh init, so round r of partition p starts at row r*adv)."""
     from ripplemq_tpu.core.config import ALIGN
-    from ripplemq_tpu.core.encode import decode_entries
 
     adv = -(-batch // ALIGN) * ALIGN
     parts = sorted({0, 1, cfg.partitions // 2, cfg.partitions - 1})
@@ -113,25 +136,10 @@ def _verify_readback(cfg, fns, state, rounds: int, batch: int) -> None:
     for p in parts:
         for r in some_rounds:
             for replica in (0, cfg.replicas - 1):
-                msgs: list[bytes] = []
-                offset = r * adv
-                while len(msgs) < batch:  # reads window read_batch rows
-                    data, lens, count = fns.read(
-                        state, np.int32(replica), np.int32(p),
-                        np.int32(offset)
-                    )
-                    got = decode_entries(data, lens, count)
-                    assert got, (
-                        f"readback: partition {p} round {r} replica "
-                        f"{replica}: {len(msgs)} of {batch} messages"
-                    )
-                    msgs.extend(got)
-                    offset += int(count)
-                for m in msgs[:batch]:
-                    assert m == PAYLOAD, (
-                        f"readback: corrupt payload at partition {p} round "
-                        f"{r} replica {replica}: {m[:24]!r}..."
-                    )
+                _read_and_check(
+                    cfg, fns, state, replica, p, r * adv, batch,
+                    f"partition {p} round {r} replica {replica}",
+                )
 
 
 def _run_mode(cfg, batch_per_partition: int, rounds: int, warmup: int,
@@ -182,7 +190,9 @@ def _run_mode(cfg, batch_per_partition: int, rounds: int, warmup: int,
 
 
 def _run_sustained(cfg, chain: int = 8, launches: int = 480,
-                   windows: int = 3, verify: bool = True) -> float:
+                   windows: int = 3, verify: bool = True,
+                   batch_per_partition: int | None = None,
+                   partitions: int | None = None) -> float:
     """STEADY-STATE committed-appends/sec: the ring WRAPS. The host
     advances the trim watermark ahead of each launch exactly as the
     broker does once rows are persisted (DataPlane drain raises trim to
@@ -199,66 +209,74 @@ def _run_sustained(cfg, chain: int = 8, launches: int = 480,
     state's ring tail is byte-verified after the clock stops."""
     import jax
 
+    from ripplemq_tpu.core.config import ALIGN
+
     fns, alive, quorum, build = _make(cfg)
-    B = cfg.max_batch
-    one = build(cfg, appends={p: [PAYLOAD] * B for p in range(cfg.partitions)},
+    bpp = cfg.max_batch if batch_per_partition is None else batch_per_partition
+    nparts = cfg.partitions if partitions is None else partitions
+    adv_round = -(-bpp // ALIGN) * ALIGN  # ALIGN-padded rows per round
+    one = build(cfg, appends={p: [PAYLOAD] * bpp for p in range(nparts)},
                 leader=0, term=1)
     inp = jax.device_put(jax.tree.map(
         lambda x: np.broadcast_to(x, (chain,) + x.shape).copy(), one
     ))
-    adv = chain * B  # rows per launch per partition (B is ALIGN-padded)
+    adv = chain * adv_round  # rows per launch per appending partition
+    # Stage every launch's trim watermark on device BEFORE the timed
+    # window: a per-launch host numpy argument costs a blocking H2D
+    # transfer that serializes the pipeline (measured 2.4x on the
+    # single-partition baseline shape).
+    trims = [
+        jax.device_put(np.full((cfg.partitions,),
+                               max(0, (k + 1) * adv - cfg.slots), np.int32))
+        for k in range(launches)
+    ]
     state = fns.init()
     state, out = fns.step_many(state, inp, alive, quorum,
-                               np.zeros((cfg.partitions,), np.int32))
+                               jax.device_put(
+                                   np.zeros((cfg.partitions,), np.int32)))
     assert bool(np.asarray(out.committed).all()), "warmup launch failed"
-    best, best_state = 0.0, None
+    best = 0.0
     for _ in range(windows):
         state = fns.init()
         t0 = time.perf_counter()
         for k in range(launches):
-            trim = np.full((cfg.partitions,),
-                           max(0, (k + 1) * adv - cfg.slots), np.int32)
-            state, out = fns.step_many(state, inp, alive, quorum, trim)
+            state, out = fns.step_many(state, inp, alive, quorum, trims[k])
         committed = np.asarray(out.committed)  # host fetch = fence
         dt = time.perf_counter() - t0
         assert bool(committed.all()), "sustained round failed"
-        rate = launches * adv * cfg.partitions / dt
+        rate = launches * chain * bpp * nparts / dt
         if rate > best:
-            best, best_state = rate, state
-    if verify:
-        _verify_ring_tail(cfg, fns, best_state, total_rows=launches * adv)
+            best = rate
+            if verify:
+                # Verify THIS window's tail now, between windows: pinning
+                # the state for a post-loop check would hold a second
+                # full engine state (8.3 GB at the headline shape) across
+                # the next window's init — over the HBM budget.
+                _verify_ring_tail(cfg, fns, state,
+                                  total_rows=launches * adv,
+                                  batch=bpp, adv_round=adv_round,
+                                  nparts=nparts)
     return best
 
 
-def _verify_ring_tail(cfg, fns, state, total_rows: int,
+def _verify_ring_tail(cfg, fns, state, total_rows: int, batch: int,
+                      adv_round: int, nparts: int,
                       tail_rounds: int = 3) -> None:
     """Byte-compare payloads from the last ring-resident rounds of the
     sustained run (earlier rounds were legitimately overwritten after
     trim passed them — that is the retention contract, not data loss)."""
-    from ripplemq_tpu.core.encode import decode_entries
-
-    B = cfg.max_batch
-    parts = sorted({0, 1, cfg.partitions // 2, cfg.partitions - 1})
+    # Guard small shapes: partition 1 does not exist at nparts=1 (the
+    # engine's read clips out-of-range ids to 0, which would silently
+    # re-verify partition 0 and overstate coverage).
+    parts = sorted({0, nparts // 2, nparts - 1}
+                   | ({1} if nparts > 1 else set()))
     for p in parts:
         for r in range(tail_rounds):
-            offset = total_rows - (r + 1) * B
-            got: list[bytes] = []
-            while len(got) < B:
-                data, lens, count = fns.read(
-                    state, np.int32(0), np.int32(p), np.int32(offset)
-                )
-                msgs = decode_entries(data, lens, count)
-                assert msgs, (
-                    f"sustained readback: partition {p} offset {offset}: "
-                    f"{len(got)} of {B} messages"
-                )
-                got.extend(msgs)
-                offset += int(count)
-            for m in got[:B]:
-                assert m == PAYLOAD, (
-                    f"sustained readback: corrupt payload at partition {p}: "
-                    f"{m[:24]!r}..."
-                )
+            offset = total_rows - (r + 1) * adv_round
+            _read_and_check(
+                cfg, fns, state, 0, p, offset, batch,
+                f"sustained partition {p} offset {offset}",
+            )
 
 
 def _run_latency(cfg, submitters: int = 16,
@@ -530,6 +548,40 @@ def _run_spmd_parity(rounds: int = 48) -> dict:
     }
 
 
+def e2e_raw_config(ports: list[int], partitions: int = 1024) -> dict:
+    """The e2e topology's cluster config (shared with
+    profiles/host_edge.py, whose decomposition must measure the SAME
+    shape the bench runs — a copied dict drifts)."""
+    return {
+        "brokers": [{"id": i, "host": "127.0.0.1", "port": p}
+                    for i, p in enumerate(ports)],
+        "topics": [{"name": "bench", "partitions": partitions,
+                    "replication_factor": 3}],
+        # The engine-headline shape (RF 3 here: topic RF is capped by
+        # the broker count; the engine still runs R=5 replica slots).
+        # read_batch 1024: the consume phase drains through the host
+        # mirror, which serves up to read_batch rows per call, and each
+        # read's auto-commit rides a ~100 ms quorum round
+        # (profiles/host_edge.py) — the commit is the consume path's
+        # dominant term, so bigger read windows amortize it ~linearly.
+        "engine": {
+            "partitions": partitions, "replicas": 5, "slots": 12352,
+            "slot_bytes": 128, "max_batch": 256, "read_batch": 1024,
+            "max_consumers": 64, "max_offset_updates": 8,
+        },
+        "election_timeout_s": 0.5,
+        "metadata_election_timeout_s": 1.5,
+        "membership_poll_s": 0.5,
+        "rpc_timeout_s": 60.0,   # a queued append must outlive a backlog
+        "rpc_workers": 64,       # workers block on round futures (see
+                                 # ClusterConfig.rpc_workers)
+        # Throughput operating point (the operating_curve documents the
+        # latency cost): gather ~coalesce_s of burst per dispatch, since
+        # each launch costs ~11 ms through the tunnel (PROFILE.md).
+        "coalesce_s": 0.01,
+    }
+
+
 def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
              threads: int = 8, batch: int = 256, window: int = 8) -> dict:
     """END-TO-END produce throughput: fresh, distinct payloads streamed
@@ -569,33 +621,7 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
         s.close()
 
     partitions = 1024
-    raw = {
-        "brokers": [{"id": i, "host": "127.0.0.1", "port": p}
-                    for i, p in enumerate(ports)],
-        "topics": [{"name": "bench", "partitions": partitions,
-                    "replication_factor": 3}],
-        # The engine-headline shape (RF 3 here: topic RF is capped by
-        # the broker count; the engine still runs R=5 replica slots).
-        # read_batch 256: the consume phase drains through the host
-        # mirror, which serves up to read_batch rows per call — bigger
-        # windows amortize the per-RPC (socket + codec + commit) cost
-        # the 1-core host pays per read.
-        "engine": {
-            "partitions": partitions, "replicas": 5, "slots": 12352,
-            "slot_bytes": 128, "max_batch": 256, "read_batch": 256,
-            "max_consumers": 64, "max_offset_updates": 8,
-        },
-        "election_timeout_s": 0.5,
-        "metadata_election_timeout_s": 1.5,
-        "membership_poll_s": 0.5,
-        "rpc_timeout_s": 60.0,   # a queued append must outlive a backlog
-        "rpc_workers": 64,       # workers block on round futures (see
-                                 # ClusterConfig.rpc_workers)
-        # Throughput operating point (the operating_curve documents the
-        # latency cost): gather ~coalesce_s of burst per dispatch, since
-        # each launch costs ~11 ms through the tunnel (PROFILE.md).
-        "coalesce_s": 0.01,
-    }
+    raw = e2e_raw_config(ports, partitions)
     tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
     config = parse_cluster_config(raw)
     brokers = []
@@ -726,8 +752,11 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
         cerrors: list = []
 
         def drainer(tid: int) -> None:
+            # Window = the broker's read_batch: one mirror read (and one
+            # ~100 ms auto-commit round) per full window.
             cc = ConsumerClient(bootstrap, f"e2e-drain-{tid}",
-                                max_messages=256, rpc_timeout_s=60.0)
+                                max_messages=raw["engine"]["read_batch"],
+                                rpc_timeout_s=60.0)
             try:
                 for p in range(tid, partitions, threads):
                     while True:
@@ -830,12 +859,18 @@ def main() -> None:
 
     # Baseline mode: the reference's shape — 1 partition, RF 5, ONE entry
     # per strictly-sequential round (max_batch stays at the ALIGN minimum;
-    # only one row per round carries a payload).
+    # only one row per round carries a payload). Measured with the SAME
+    # sustained method as the numerator (ring wraps behind trim, window
+    # long enough to amortize the fixed window cost) so vs_baseline
+    # compares architectures, not measurement methods; rounds stay
+    # semantically sequential — each depends on the previous state.
     base_cfg = EngineConfig(
         partitions=1, replicas=5, slots=2048, slot_bytes=128,
         max_batch=8, read_batch=32, max_consumers=64, max_offset_updates=8,
     )
-    base_rate = _run_mode(base_cfg, batch_per_partition=1, rounds=200, warmup=5)
+    base_rate = _run_sustained(base_cfg, chain=1, launches=2000, windows=3,
+                               verify=True, batch_per_partition=1,
+                               partitions=1)
 
     # Latency through the full host batcher uses the broker's default
     # shape (32-row windows): produce-ack latency is about small-round

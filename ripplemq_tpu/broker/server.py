@@ -282,6 +282,7 @@ class BrokerServer:
         # Repair-scan cadence (see _controller_duty): lag repair needs a
         # device fetch, so it must not ride every duty tick.
         self._last_repair_scan = 0.0
+        self._engine_busy_at = 0.0  # last duty tick the plane looked busy
         # Read-index barrier (linearizable_reads; see _BarrierGate).
         self._barrier_gate = _BarrierGate(self._fire_read_barrier)
 
@@ -1213,10 +1214,23 @@ class BrokerServer:
         # Repair scans defer while the plane is busy (the fetch would
         # drain the dispatch pipeline; see DataPlane.busy) — but never
         # beyond 30 s, so lagging replicas still catch up under
-        # sustained load.
-        since_repair = time.monotonic() - self._last_repair_scan
+        # sustained load. Busy is judged with hysteresis: under
+        # intermittent traffic (e.g. a consume drain whose offset
+        # commits ride spaced quorum rounds) a POINT sample of busy()
+        # flickers False between rounds, and a repair scan fired into
+        # that gap stalls the next ~1 s of dispatches behind its fetch
+        # (measured: the r4 consume drain spent more time in duty-loop
+        # log_ends fetches than in its own commit rounds). The plane
+        # must have looked idle for 10 consecutive duty ticks before an
+        # optional scan touches the device.
+        now = time.monotonic()
+        if dp.busy():
+            self._engine_busy_at = now
+        since_repair = now - self._last_repair_scan
+        idle_for = now - self._engine_busy_at
         due_repairs = since_repair >= max(1.0, self._duty_interval_s * 10)
-        if due_repairs and dp.busy() and since_repair < 30.0:
+        if (due_repairs and since_repair < 30.0
+                and idle_for < max(0.5, self._duty_interval_s * 10)):
             due_repairs = False
         if not self.manager.needs_elections() and not due_repairs:
             return
