@@ -102,7 +102,7 @@ def _make(cfg):
     return fns, alive, quorum, build_step_input
 
 
-def _read_and_check(cfg, fns, state, replica: int, p: int, offset: int,
+def _read_and_check(fns, state, replica: int, p: int, offset: int,
                     batch: int, where: str) -> None:
     """Walk the read window from `offset` until `batch` messages arrived
     and byte-compare each against PAYLOAD (shared by the burst-window
@@ -137,7 +137,7 @@ def _verify_readback(cfg, fns, state, rounds: int, batch: int) -> None:
         for r in some_rounds:
             for replica in (0, cfg.replicas - 1):
                 _read_and_check(
-                    cfg, fns, state, replica, p, r * adv, batch,
+                    fns, state, replica, p, r * adv, batch,
                     f"partition {p} round {r} replica {replica}",
                 )
 
@@ -252,14 +252,14 @@ def _run_sustained(cfg, chain: int = 8, launches: int = 480,
                 # the state for a post-loop check would hold a second
                 # full engine state (8.3 GB at the headline shape) across
                 # the next window's init — over the HBM budget.
-                _verify_ring_tail(cfg, fns, state,
+                _verify_ring_tail(fns, state,
                                   total_rows=launches * adv,
                                   batch=bpp, adv_round=adv_round,
                                   nparts=nparts)
     return best
 
 
-def _verify_ring_tail(cfg, fns, state, total_rows: int, batch: int,
+def _verify_ring_tail(fns, state, total_rows: int, batch: int,
                       adv_round: int, nparts: int,
                       tail_rounds: int = 3) -> None:
     """Byte-compare payloads from the last ring-resident rounds of the
@@ -274,7 +274,7 @@ def _verify_ring_tail(cfg, fns, state, total_rows: int, batch: int,
         for r in range(tail_rounds):
             offset = total_rows - (r + 1) * adv_round
             _read_and_check(
-                cfg, fns, state, 0, p, offset, batch,
+                fns, state, 0, p, offset, batch,
                 f"sustained partition {p} offset {offset}",
             )
 

@@ -51,6 +51,14 @@ def _t(fn, n: int, *, warmup: int = 3) -> float:
     return float(np.median(ts) * 1e3)
 
 
+def _ok(resp: dict) -> dict:
+    """Timed RPCs must measure the REAL path: a refusal (e.g. a
+    not-yet-settled leadership) answers in ~0.1 ms and would silently
+    median into the table as if it were the full round."""
+    assert resp.get("ok"), resp
+    return resp
+
+
 def main() -> None:
     from ripplemq_tpu.broker.server import BrokerServer
     from ripplemq_tpu.core.encode import pack_payload_rows
@@ -111,7 +119,7 @@ def main() -> None:
         # small error dict without touching the data plane.
         out["socket_rtt_small_ms"] = _t(
             lambda: client.call(addr, {"type": "edge.probe"}, timeout=10.0),
-            40)
+            40)  # error-path reply BY DESIGN: times the socket edge alone
 
         # --- host packing + engine round ---------------------------------
         cfg = dp.cfg
@@ -124,7 +132,7 @@ def main() -> None:
 
         # --- full produce RPC (socket + codec + dispatch + engine) -------
         out["produce_rpc256_ms"] = _t(
-            lambda: client.call(addr, produce_req, timeout=60.0), 24)
+            lambda: _ok(client.call(addr, produce_req, timeout=60.0)), 24)
 
         # --- consume side -------------------------------------------------
         reg = client.call(addr, {"type": "consume", "topic": "bench",
@@ -145,16 +153,16 @@ def main() -> None:
         assert cm["ok"], cm
         out["mirror_read256_ms"] = _t(lambda: dp.read(0, tail, replica=0), 40)
         out["consume_rpc256_ms"] = _t(
-            lambda: client.call(
+            lambda: _ok(client.call(
                 addr, {"type": "consume", "topic": "bench", "partition": 0,
                        "consumer": "edge", "max_messages": 256},
-                timeout=30.0),
+                timeout=30.0)),
             24)
         out["offset_commit_rpc_ms"] = _t(
-            lambda: client.call(
+            lambda: _ok(client.call(
                 addr, {"type": "offset.commit", "topic": "bench",
                        "partition": 0, "consumer": "edge", "offset": 1},
-                timeout=60.0),
+                timeout=60.0)),
             24)
         out["submit_offsets_direct_ms"] = _t(
             lambda: dp.submit_offsets(0, [(0, 1)]).result(timeout=60), 24)

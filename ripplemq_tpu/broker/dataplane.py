@@ -419,6 +419,15 @@ class DataPlane:
         with self._lock:
             self.quorum = quorum.copy()
 
+    @property
+    def broken_reason(self) -> Optional[str]:
+        """Non-None once the plane is PERMANENTLY unable to commit (the
+        lockstep mesh broke: a worker process died or fell out of
+        sequence). The controller broker polls this and abdicates —
+        controller failover is the recovery path, exactly as for
+        controller death (parallel/lockstep.py module docstring)."""
+        return getattr(self.fns, "broken", None)
+
     def _adopt_lockstep_state(self, e: Exception) -> None:
         """A LockstepController call failed AFTER its local launch ran:
         the donated state buffers are gone, and the error carries their
@@ -1591,6 +1600,12 @@ class DataPlane:
                  "max log end %d", int((ends > 0).sum()), int(ends.max()))
 
     def _fail_round(self, ctx, exc: Exception) -> None:
+        if self.broken_reason is not None and not isinstance(
+                exc, NotCommittedError):
+            # Producers must see a RETRYABLE refusal (retry lands on the
+            # promoted controller after abdication), not an opaque
+            # internal RuntimeError from the lockstep transport.
+            exc = NotCommittedError(f"data plane broken: {exc}")
         for taken in ctx["appends"].values():
             for pend, _, _ in taken:
                 if not pend.future.done():

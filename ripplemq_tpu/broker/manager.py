@@ -465,16 +465,38 @@ class PartitionManager:
                         "standbys": [s for s in self.standbys if s in alive],
                     }
                 return None
-            cands = [s for s in self.standbys if s in alive]
-            if not cands:
+            return self._promote_cmd([s for s in self.standbys if s in alive])
+
+    def _promote_cmd(self, cands: list[int]) -> Optional[dict]:
+        """Promotion command shared by dead-controller failover and
+        broken-plane abdication (one handover contract; lock held).
+        Lowest live standby wins under a bumped epoch."""
+        if not cands:
+            return None
+        new = min(cands)
+        return {
+            "op": OP_SET_CONTROLLER,
+            "controller": new,
+            "epoch": self.controller_epoch + 1,
+            "standbys": [s for s in cands if s != new],
+        }
+
+    def plan_abdication(self) -> Optional[dict]:
+        """Called on a controller whose OWN data plane is permanently
+        broken (lockstep mesh break — the broker is alive, so the
+        metadata leader's dead-controller planning never fires): hand
+        controllership to the lowest-id live standby under a bumped
+        epoch. Same safety rule as plan_controller: only standby-set
+        members hold the full committed-round stream; with no live
+        standby the plane stays down (returns None) rather than losing
+        acked data."""
+        with self.lock:
+            if self.controller_broker != self.broker_id:
                 return None
-            new = min(cands)
-            return {
-                "op": OP_SET_CONTROLLER,
-                "controller": new,
-                "epoch": self.controller_epoch + 1,
-                "standbys": [s for s in cands if s != new],
-            }
+            return self._promote_cmd([
+                s for s in self.standbys
+                if s in self.live and s != self.broker_id
+            ])
 
     def plan_standby_add(self, target_count: int) -> Optional[int]:
         """Called on the controller: pick one live broker to catch up and

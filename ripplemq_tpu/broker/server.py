@@ -1133,6 +1133,7 @@ class BrokerServer:
         while not self._stop.wait(self._duty_interval_s):
             try:
                 self._metadata_leader_duty()
+                self._abdicate_duty()
                 self._fence_duty()
                 self._takeover_duty()
                 self._controller_duty()
@@ -1164,6 +1165,40 @@ class BrokerServer:
         ctrl_cmd = self.manager.plan_controller(alive)
         if ctrl_cmd is not None:
             self.runner.propose(ctrl_cmd)
+
+    def _abdicate_duty(self) -> None:
+        """Controller whose data plane broke PERMANENTLY (lockstep mesh
+        break: an engine-worker process died mid-call) while the broker
+        itself is alive: the metadata leader's dead-controller planning
+        never fires, so the controller must surrender. Propose promotion
+        of a live standby under a bumped epoch; the fence duty then
+        releases the broken plane and the promoted standby's takeover
+        duty boots from its copy of the committed-round stream — zero
+        settled-append loss, the same guarantee as controller death
+        (every settled round was acked by the full standby set)."""
+        dp = self.dataplane
+        if dp is None or not self._owns_dataplane:
+            return
+        reason = dp.broken_reason
+        if reason is None:
+            return
+        if self.manager.current_controller() != self.broker_id:
+            return  # already deposed; fence duty will release the plane
+        cmd = self.manager.plan_abdication()
+        if cmd is None:
+            log.warning(
+                "broker %d: data plane broken (%s) but no live standby "
+                "to abdicate to; plane stays down", self.broker_id, reason,
+            )
+            return
+        log.warning(
+            "broker %d: data plane broken (%s); abdicating controllership "
+            "to broker %d (epoch %d)",
+            self.broker_id, reason, cmd["controller"], cmd["epoch"],
+        )
+        self.propose_cmd(cmd)
+        # The apply flips current_controller; the fence duty (same duty
+        # pass) releases the broken plane.
 
     def _fence_duty(self) -> None:
         """Deposed controller: release the device program and revert to a

@@ -95,6 +95,12 @@ class LockstepController:
         self._seq = 0
         self._lock = threading.Lock()
         self.mesh = inner.mesh
+        # Set (to a reason string) the first time a broadcast or replay
+        # fails: the mesh is permanently out of lockstep — no later call
+        # can succeed, and the broker reading this flag must surrender
+        # the device program (abdication → standby promotion). Never
+        # cleared: a broken controller builds a NEW plane, not this one.
+        self.broken: str | None = None
         # Workers build their engine from this exact shape (no local op
         # to overlap: configure launches nothing on the mesh).
         with self._lock:
@@ -135,9 +141,15 @@ class LockstepController:
         computation order always matches the sequence order the workers
         replay in (a cross-thread inversion would rendezvous mismatched
         collectives)."""
-        with self._lock:
-            futs = self._send(method, args)
-            result = local_fn()
+        try:
+            with self._lock:
+                futs = self._send(method, args)
+                result = local_fn()
+        except Exception as e:
+            # Broadcast (or local launch) failed before completing: the
+            # call stream is no longer replayable in order.
+            self.broken = f"{type(e).__name__}: {e}"
+            raise
         try:
             self._check(futs)
         except Exception as e:
@@ -146,6 +158,7 @@ class LockstepController:
             # caller (DataPlane) can adopt the new state and fail loudly
             # with the lockstep-break diagnostic, instead of wedging every
             # subsequent engine call on donated-buffer errors.
+            self.broken = f"{type(e).__name__}: {e}"
             e.lockstep_result = result
             raise
         return result

@@ -38,13 +38,17 @@ def make_config(n_brokers=3, topics=None, engine=None, **kw) -> ClusterConfig:
 
 class InProcCluster:
     def __init__(self, config: ClusterConfig | None = None, n_brokers=3,
-                 data_dir=None):
+                 data_dir=None, broker_kwargs=None):
         """`data_dir`: optional root for per-broker durable stores
         (<data_dir>/broker-<id>); enables restart-with-recovery (the
-        randomized soak's kill/restart schedule)."""
+        randomized soak's kill/restart schedule). `broker_kwargs`:
+        optional {broker_id: extra BrokerServer kwargs} — e.g. the
+        lockstep drill gives the controller `engine_mode="spmd"` and
+        `engine_workers=[...]` while the standbys stay local."""
         self.config = config or make_config(n_brokers)
         self.net = InProcNetwork()
         self._data_dir = data_dir
+        self._broker_kwargs = dict(broker_kwargs or {})
         self.brokers: dict[int, BrokerServer] = {}
         for b in self.config.brokers:
             self.brokers[b.broker_id] = self._make(b.broker_id)
@@ -63,6 +67,7 @@ class InProcCluster:
             tick_interval_s=0.02,
             duty_interval_s=0.05,
             data_dir=data_dir,
+            **self._broker_kwargs.get(broker_id, {}),
         )
 
     def kill(self, broker_id: int) -> None:

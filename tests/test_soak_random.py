@@ -54,15 +54,24 @@ def _cluster_healthy(c):
     return all(c._all_leaders_known(b) for b in c.brokers.values())
 
 
-@pytest.mark.parametrize("seed,linearizable", [
-    (11, False), (23, False), (37, False), (41, False), (53, False),
+@pytest.mark.parametrize("seed,linearizable,engine_mode", [
+    (11, False, "local"), (23, False, "local"), (37, False, "local"),
+    (41, False, "local"), (53, False, "local"),
     # One schedule with the read-index barrier ON: consumes prove the
     # controller epoch through the standby ack stream, so every fault
     # round also exercises barrier x failover interleavings (refusals
     # during churn are retried by the drain helpers).
-    (61, True),
+    (61, True, "local"),
+    # One schedule with the PRODUCTION dispatch binding: every broker
+    # boots its plane as shard_map over the virtual device mesh
+    # (tests/conftest.py forces 8 CPU devices), so sharded control
+    # tables, active-set id translation, and spmd recovery/installs see
+    # the same kill/restart/burst churn the local binding does
+    # (VERDICT r4 next-#9).
+    (71, False, "spmd"),
 ])
-def test_randomized_fault_schedule(seed, linearizable, tmp_path):
+def test_randomized_fault_schedule(seed, linearizable, engine_mode,
+                                   tmp_path):
     rng = random.Random(seed)
     config = make_config(
         n_brokers=4,
@@ -76,7 +85,12 @@ def test_randomized_fault_schedule(seed, linearizable, tmp_path):
     acked: list[bytes] = []
     dead: set[int] = set()
 
-    with InProcCluster(config, data_dir=tmp_path) as c:
+    broker_kwargs = (
+        {i: {"engine_mode": "spmd"} for i in range(4)}
+        if engine_mode == "spmd" else None
+    )
+    with InProcCluster(config, data_dir=tmp_path,
+                       broker_kwargs=broker_kwargs) as c:
         c.wait_for_leaders()
         assert wait_until(
             lambda: len(next(iter(c.brokers.values()))
