@@ -436,7 +436,22 @@ class PartitionManager:
                     previous=base if have_assignments else None,
                 )
             except ValueError:
-                return None  # not enough live brokers to meet RF; keep old
+                # Not enough live brokers to meet RF. Keep the old
+                # PLACEMENT — but still advance the LIVE view: leader
+                # elections key on `self.live` (needs_elections/
+                # plan_elections), so freezing it would leave a dead
+                # broker's partitions leaderless forever whenever
+                # RF == cluster size (the surviving quorum can and must
+                # still elect among itself — the reference's JRaft groups
+                # re-elect independently of placement,
+                # PartitionRaftServer.java:83-93).
+                if not have_assignments:
+                    return None
+                return {
+                    "op": OP_SET_TOPICS,
+                    "topics": topics_to_wire(self.topics),
+                    "live": sorted(alive_brokers),
+                }
             return {
                 "op": OP_SET_TOPICS,
                 "topics": topics_to_wire(new_topics),

@@ -263,13 +263,25 @@ class BrokerServer:
         self._owns_dataplane = False
         self._replicator = None
         self._catchup_thread: Optional[threading.Thread] = None
+        self._boot_failures = 0     # consecutive data-plane boot failures
         if dataplane is not None:
             self.dataplane = dataplane
             self.manager.attach_dataplane(dataplane)
             if dataplane.replicate_fn is None and self._round_store is not None:
                 dataplane.replicate_fn = self._make_replicator().replicate
         elif self.manager.current_controller() == broker_id:
-            self._boot_dataplane()
+            try:
+                self._boot_dataplane()
+            except Exception as e:
+                # A failed genesis boot (e.g. an engine worker not up
+                # yet) must not kill the broker: the takeover duty
+                # retries while dataplane is None — and abdicates after
+                # repeated failures once standbys exist.
+                log.warning(
+                    "broker %d: genesis data-plane boot failed "
+                    "(duty loop will retry): %s: %s",
+                    broker_id, type(e).__name__, e,
+                )
 
         self._duty_thread = threading.Thread(
             target=self._duty_loop, daemon=True, name=f"broker-duty-{broker_id}"
@@ -317,14 +329,42 @@ class BrokerServer:
             image = replay_records(
                 self.config.engine, self._round_store.scan()
             )
-        dp = DataPlane(
-            self.config.engine, mode=self._engine_mode,
-            store=self._round_store,
-            workers=self._engine_workers or None,
-            coalesce_s=self.config.coalesce_s,
-            chain_depth=self.config.chain_depth,
-            pipeline_depth=self.config.pipeline_depth,
-        )
+        try:
+            dp = DataPlane(
+                self.config.engine, mode=self._engine_mode,
+                store=self._round_store,
+                workers=self._engine_workers or None,
+                coalesce_s=self.config.coalesce_s,
+                chain_depth=self.config.chain_depth,
+                pipeline_depth=self.config.pipeline_depth,
+            )
+        except Exception as e:
+            # Boot-time lockstep failure (a worker dead when the plane is
+            # (re)built) raises from LockstepController's configure
+            # broadcast BEFORE a DataPlane exists, so the mid-call
+            # broken-plane path (_abdicate_duty reading dp.broken_reason)
+            # never engages — without this, a live broker holding
+            # controllership retries a doomed boot forever and the plane
+            # stays down. After a few consecutive failures (grace for a
+            # worker that is merely still starting), abdicate the same
+            # way a mid-call break does.
+            self._boot_failures += 1
+            log.warning(
+                "broker %d: data-plane boot failed (%d consecutive): "
+                "%s: %s", self.broker_id, self._boot_failures,
+                type(e).__name__, e,
+            )
+            if self._boot_failures >= 3:
+                cmd = self.manager.plan_abdication()
+                if cmd is not None:
+                    log.warning(
+                        "broker %d: abdicating controllership to broker "
+                        "%d after repeated boot failures",
+                        self.broker_id, cmd["controller"],
+                    )
+                    self.propose_cmd(cmd)
+            raise
+        self._boot_failures = 0
         if image is not None:
             dp.install(image)
         if self._round_store is not None:
@@ -384,6 +424,12 @@ class BrokerServer:
         self._duty_thread.start()
 
     def stop(self) -> None:
+        # Idempotent: a killed-but-never-restarted broker is stopped
+        # again by harness/cluster teardown, and the second pass must
+        # not flush the segment store the first one closed.
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self._stop.set()
         self._duty_thread.join(timeout=2)
         self.runner.stop()
@@ -1231,6 +1277,11 @@ class BrokerServer:
         if self.dataplane is not None:
             return
         if self.manager.current_controller() != self.broker_id:
+            # Not (or no longer) the controller: any FUTURE promotion
+            # starts with the full boot-failure grace — without this
+            # reset, a broker that once abdicated over boot failures
+            # would re-abdicate on its first hiccup when re-promoted.
+            self._boot_failures = 0
             return
         if self._round_store is None:
             return

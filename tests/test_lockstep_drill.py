@@ -121,14 +121,20 @@ with c:
     assert c.brokers[0].dataplane.broken_reason is None
 
     # Kill the engine worker mid-traffic: produce concurrently so some
-    # round is in flight when the mesh breaks.
+    # round is in flight when the mesh breaks. Every SUCCESSFUL mid-kill
+    # produce is recorded into `settled` — an ack is a settlement claim
+    # regardless of when it lands, and an append acked just before/as
+    # the mesh breaks then lost across abdication is exactly the
+    # regression this drill exists to catch.
     import threading
     killed = threading.Event()
     def traffic():
         i = 100
         while not killed.is_set():
+            m = b"mid-%03d" % i
             try:
-                produce(client, i % 2, b"mid-%03d" % i, timeout=5.0)
+                produce(client, i % 2, m, timeout=5.0)
+                settled.append((i % 2, m))
             except Exception:
                 pass
             i += 1
@@ -214,3 +220,78 @@ def test_lockstep_worker_death_recovers_via_abdication():
         raise AssertionError(f"drill orchestrator hung\n{err[-4000:]}")
     assert orch.returncode == 0, f"orchestrator rc={orch.returncode}\n{err[-5000:]}"
     assert "DRILL_OK" in out, (out, err[-2000:])
+
+
+def test_boot_time_lockstep_failure_abdicates():
+    """A controller whose lockstep plane cannot BOOT (worker dead when
+    the plane is built — LockstepController's configure raises before a
+    DataPlane exists, so the mid-call broken_reason path never engages)
+    must also abdicate after a few consecutive boot failures, instead of
+    retrying a doomed boot forever while holding controllership.
+
+    Staged without jax.distributed: broker 1 is configured spmd with an
+    unreachable engine worker; killing the healthy controller (broker 0)
+    promotes broker 1, whose takeover boot fails repeatedly → it
+    abdicates to broker 2, which restores service."""
+    import socket as socketmod
+    import time
+
+    from ripplemq_tpu.metadata.models import Topic
+    from tests.broker_harness import InProcCluster, make_config
+    from tests.helpers import small_cfg
+    from tests.test_controller_failover import _produce, _wait_standbys, \
+        wait_until
+
+    s = socketmod.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()  # nothing listens here: configure fails fast
+
+    config = make_config(
+        n_brokers=3,
+        topics=(Topic("t", 1, 3),),
+        engine=small_cfg(partitions=1, replicas=3, slots=256),
+        metadata_election_timeout_s=0.6,
+        standby_count=2,
+    )
+    import tempfile
+    with tempfile.TemporaryDirectory(prefix="rmq-bootfail-") as tmp:
+        with InProcCluster(
+            config, data_dir=tmp,
+            broker_kwargs={1: {
+                "engine_mode": "spmd",
+                "engine_workers": [f"127.0.0.1:{dead_port}"],
+            }},
+        ) as c:
+            c.wait_for_leaders()
+            _wait_standbys(c, 2)
+            client = c.client()
+            _produce(c, client, "t", 0, b"pre-bootfail")
+            c.kill(0)
+            # Broker 1 (lowest standby) is promoted, fails its boots,
+            # and must hand controllership on to broker 2.
+            assert wait_until(
+                lambda: c.brokers[2].manager.current_controller() == 2,
+                timeout=120,
+            ), "boot-failing promotee never abdicated to broker 2"
+            assert wait_until(
+                lambda: c.brokers[2].dataplane is not None, timeout=60
+            ), "broker 2 never booted the plane"
+            # Service restored; the pre-kill append survived.
+            _produce(c, client, "t", 0, b"post-bootfail", dead={0})
+            got = []
+            for _ in range(100):
+                resp = client.call(
+                    c.brokers[c.brokers[2].manager.leader_of(("t", 0))].addr,
+                    {"type": "consume", "topic": "t", "partition": 0,
+                     "consumer": "bf"}, timeout=30.0)
+                assert resp["ok"], resp
+                if not resp["messages"]:
+                    break
+                got.extend(resp["messages"])
+                client.call(
+                    c.brokers[c.brokers[2].manager.leader_of(("t", 0))].addr,
+                    {"type": "offset.commit", "topic": "t", "partition": 0,
+                     "consumer": "bf", "offset": resp["next_offset"]},
+                    timeout=30.0)
+            assert b"pre-bootfail" in got and b"post-bootfail" in got, got
