@@ -367,10 +367,13 @@ class DataPlane:
         self._stop.set()
         self._work.set()
         self._read_work.set()
-        self._thread.join(timeout=5)
-        self._read_thread.join(timeout=5)
-        for r in self._resolvers:
-            r.join(timeout=10)  # lands every dispatched round
+        # A never-started plane (boot failed between construction and
+        # start — server._boot_dataplane's cleanup path) must still run
+        # the rest of stop (fail queued futures, flush): joining an
+        # unstarted Thread raises, so join only what ran.
+        for t in (self._thread, self._read_thread, *self._resolvers):
+            if t.ident is not None:
+                t.join(timeout=10)  # lands every dispatched round
         with self._read_lock:
             stranded = self._reads
             self._reads = []

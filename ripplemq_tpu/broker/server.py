@@ -273,10 +273,14 @@ class BrokerServer:
             try:
                 self._boot_dataplane()
             except Exception as e:
-                # A failed genesis boot (e.g. an engine worker not up
-                # yet) must not kill the broker: the takeover duty
-                # retries while dataplane is None — and abdicates after
-                # repeated failures once standbys exist.
+                # A failed genesis boot must not kill the broker: a
+                # worker-startup race (engine workers have no cross-host
+                # ordering guarantee) is indistinguishable here from a
+                # permanent misconfiguration, so the takeover duty
+                # retries while dataplane is None — every attempt is
+                # WARN-logged and counted in admin.stats
+                # (`boot_failures`), and once standbys exist repeated
+                # failures abdicate.
                 log.warning(
                     "broker %d: genesis data-plane boot failed "
                     "(duty loop will retry): %s: %s",
@@ -324,12 +328,24 @@ class BrokerServer:
             "engine mode %s)",
             self.broker_id, self.manager.current_epoch(), self._engine_mode,
         )
-        image = None
-        if self._round_store is not None:
-            image = replay_records(
-                self.config.engine, self._round_store.scan()
-            )
+        dp = None
         try:
+            # The WHOLE boot sequence is one failure domain: a raise from
+            # store replay (corrupt record), the DataPlane constructor
+            # (boot-time lockstep failure — a worker dead when the plane
+            # is (re)built raises from the configure broadcast BEFORE a
+            # DataPlane exists, so the mid-call broken-plane path reading
+            # dp.broken_reason never engages), install, the replicator,
+            # or start must all count toward abdication — guarding only
+            # the constructor would retry a doomed boot forever, and a
+            # post-constructor raise would leak a constructed plane
+            # (for spmd: workers already configured) into the next
+            # attempt.
+            image = None
+            if self._round_store is not None:
+                image = replay_records(
+                    self.config.engine, self._round_store.scan()
+                )
             dp = DataPlane(
                 self.config.engine, mode=self._engine_mode,
                 store=self._round_store,
@@ -338,16 +354,31 @@ class BrokerServer:
                 chain_depth=self.config.chain_depth,
                 pipeline_depth=self.config.pipeline_depth,
             )
+            if image is not None:
+                dp.install(image)
+            if self._round_store is not None:
+                dp.replicate_fn = self._make_replicator().replicate
+            self._owns_dataplane = True
+            self.dataplane = dp
+            self.manager.attach_dataplane(dp)
+            if self._started:
+                dp.start()
         except Exception as e:
-            # Boot-time lockstep failure (a worker dead when the plane is
-            # (re)built) raises from LockstepController's configure
-            # broadcast BEFORE a DataPlane exists, so the mid-call
-            # broken-plane path (_abdicate_duty reading dp.broken_reason)
-            # never engages — without this, a live broker holding
-            # controllership retries a doomed boot forever and the plane
-            # stays down. After a few consecutive failures (grace for a
-            # worker that is merely still starting), abdicate the same
-            # way a mid-call break does.
+            if self._replicator is not None:
+                self._replicator.stop()
+                self._replicator = None
+            if self.dataplane is dp:
+                self.dataplane = None
+                self.manager.detach_dataplane()
+                self._owns_dataplane = False
+            if dp is not None:
+                try:
+                    dp.stop()
+                except Exception:
+                    log.exception("stopping partially-booted plane")
+            # After a few consecutive failures (grace for a worker that
+            # is merely still starting), abdicate the same way a
+            # mid-call lockstep break does.
             self._boot_failures += 1
             log.warning(
                 "broker %d: data-plane boot failed (%d consecutive): "
@@ -365,15 +396,6 @@ class BrokerServer:
                     self.propose_cmd(cmd)
             raise
         self._boot_failures = 0
-        if image is not None:
-            dp.install(image)
-        if self._round_store is not None:
-            dp.replicate_fn = self._make_replicator().replicate
-        self._owns_dataplane = True
-        self.dataplane = dp
-        self.manager.attach_dataplane(dp)
-        if self._started:
-            dp.start()
         # Compile hot programs before traffic needs them — EVERY bucket
         # this shape can hit, or the first big produce wave charges a
         # multi-second XLA compile to live traffic. On TAKEOVER
@@ -514,6 +536,10 @@ class BrokerServer:
             "ok": True,
             "broker": self.broker_id,
             "address": self.addr,
+            # Consecutive data-plane boot failures (genesis or takeover;
+            # reset on success and on losing controllership) — makes a
+            # boot-retry loop operator-visible instead of log-only.
+            "boot_failures": self._boot_failures,
             "metadata": {
                 "role": node.role,
                 "term": node.term,

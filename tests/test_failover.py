@@ -163,3 +163,61 @@ def test_metadata_leader_death_reelects_and_heals(cluster5):
         timeout=10.0,
     )
     assert resp["ok"], resp
+
+
+def test_rf_equals_cluster_size_death_still_reelects(tmp_path):
+    """RF == broker count: a broker death makes the placement
+    UN-replannable (assign_partitions cannot meet RF with the
+    survivors), but the LIVE view must still advance — elections key on
+    it, and freezing it left the dead broker's partitions leaderless
+    forever (found by the r5 lockstep boot drill; the reference's
+    per-partition JRaft groups re-elect independently of placement,
+    PartitionRaftServer.java:83-93). Killing the CONTROLLER (which the
+    election tie-break makes leader of every partition at RF == N): the
+    standby promotion never depended on the live view, but the dead
+    broker's partitions re-elect only if it advances — the surviving
+    2-of-3 quorum must end up serving every partition."""
+    config = make_config(
+        n_brokers=3,
+        topics=(Topic("t", 2, 3),),
+        metadata_election_timeout_s=0.6,
+        membership_poll_s=0.2,
+        standby_count=2,
+    )
+    with InProcCluster(config, data_dir=tmp_path) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl = c.config.controller
+        assert wait_until(
+            lambda: len(c.brokers[ctrl].manager.current_standbys()) >= 2,
+            timeout=60,
+        ), "standbys never formed"
+        c.kill(ctrl)
+        survivor = next(b for i, b in c.brokers.items() if i != ctrl)
+        # The live view advances even though placement cannot be
+        # re-planned with 2 brokers for RF 3...
+        assert wait_until(
+            lambda: sorted(survivor.manager.live)
+            == sorted(i for i in c.brokers if i != ctrl),
+            timeout=30,
+        ), f"live view never advanced: {survivor.manager.live}"
+        # ...placement itself is untouched (nothing to re-plan to)...
+        for t in survivor.manager.get_topics():
+            for a in t.assignments:
+                assert ctrl in a.replicas
+        # ...and every partition re-elects among the surviving quorum,
+        # then serves a produce through the promoted controller's plane.
+        for pid in range(2):
+            assert wait_until(
+                lambda: survivor.manager.leader_of(("t", pid))
+                not in (None, ctrl),
+                timeout=60,
+            ), f"partition {pid} never re-elected"
+            leader = survivor.manager.leader_of(("t", pid))
+            resp = client.call(
+                c.brokers[leader].addr,
+                {"type": "produce", "topic": "t", "partition": pid,
+                 "messages": [b"rf-n-%d" % pid]},
+                timeout=30.0,
+            )
+            assert resp["ok"], resp

@@ -166,9 +166,9 @@ with c:
         produce(client, i % 2, m)
         settled.append((i % 2, m))
 
-    # ZERO settled-append loss: every payload acked before, during
-    # (none tracked — traffic() ignored failures), and after the kill
-    # is readable through the promoted controller's plane.
+    # ZERO settled-append loss: every payload acked before, DURING
+    # (traffic() records each successful mid-kill ack into `settled`),
+    # and after the kill is readable through the promoted plane.
     for pid in (0, 1):
         got = []
         for _ in range(200):
