@@ -62,6 +62,11 @@ def test_admin_stats_surface():
                             timeout=5.0)
         assert stats["ok"]
         assert stats["controller"]["is_self"]
+        # Boot health is part of the surface: a healthy boot shows zero
+        # consecutive failures (r5 — boot-retry loops must be
+        # operator-visible, not log-only).
+        assert stats["boot_failures"] == 0
+        assert stats["engine"]["mirror_gap_slots"] == 0
         assert stats["engine"]["rounds"] >= 1
         assert stats["engine"]["committed_entries"] >= 2
         assert stats["engine"]["slots"]["0"]["commit"] >= 2
