@@ -33,6 +33,10 @@ Field map:
 - `spmd_parity` — local (vmap) vs spmd (shard_map, 1x1 mesh) dispatch
   on the same chip; delta_pct must stay small for the production
   binding to be trusted at the local binding's numbers.
+- `control_fusion_ab` — same-process A/B of the fused-control and
+  packed-write levers (EngineConfig.fused_control / .packed_writes)
+  vs the legacy path: control-only ms/round, full and quarter-batch
+  sustained rates (also standalone: profiles/control_ab.py).
 
 `round_rtt_ms` is the measured single-round dispatch+fetch time on this
 chip/link — the floor any ack latency pays; read the percentiles against
@@ -221,7 +225,8 @@ def _run_sustained(cfg, chain: int = 8, launches: int = 480,
         lambda x: np.broadcast_to(x, (chain,) + x.shape).copy(), one
     ))
     adv = chain * adv_round  # rows per launch per appending partition
-    trims = _stage_trims(cfg, adv, launches, jax.device_put)
+    trims = _stage_trims(cfg, adv, launches, jax.device_put,
+                         adv_round=adv_round)
     _sustained_warmup(fns, inp, alive, quorum, trims)
     best = 0.0
     for _ in range(windows):
@@ -243,15 +248,23 @@ def _run_sustained(cfg, chain: int = 8, launches: int = 480,
     return best
 
 
-def _stage_trims(cfg, adv: int, launches: int, put) -> list:
+def _stage_trims(cfg, adv: int, launches: int, put,
+                 adv_round: int | None = None) -> list:
     """Stage every launch's trim watermark on device BEFORE the timed
     window — trim k lets launch k's rounds wrap the ring exactly as the
     broker's persisted-prefix trim does. A per-launch host numpy
     argument instead costs a blocking H2D transfer that serializes the
-    pipeline (measured 2.4x on the single-partition baseline shape)."""
+    pipeline (measured 2.4x on the single-partition baseline shape).
+
+    The capacity rule reserves the FULL max_batch window
+    (`base + B - trim <= S`, core/step.py) even when a round advances
+    fewer rows, so partial-batch windows (adv_round < B) need the trim
+    pushed `B - adv_round` rows further ahead than their own growth."""
+    reserve = cfg.max_batch - (cfg.max_batch if adv_round is None
+                               else adv_round)
     return [
         put(np.full((cfg.partitions,),
-                    max(0, (k + 1) * adv - cfg.slots), np.int32))
+                    max(0, (k + 1) * adv + reserve - cfg.slots), np.int32))
         for k in range(launches)
     ]
 
@@ -297,6 +310,104 @@ def _verify_ring_tail(fns, state, total_rows: int, batch: int,
                 fns, state, 0, p, offset, batch,
                 f"sustained partition {p} offset {offset}",
             )
+
+
+def _run_control_only(cfg, chain: int = 8, launches: int = 240,
+                      windows: int = 3) -> float:
+    """CONTROL-PHASE rounds/s, sustained method: offsets-only rounds
+    commit (has_work) but advance no log rows, so the wrote_rows gate
+    skips the append kernel entirely — what remains per round is the
+    ballot + bookkeeping + offset blend, i.e. the control phase the
+    PROFILE.md r5 decomposition priced at ~0.445 ms at the headline
+    shape. This is the empty-round side of the fusion A/B: run it with
+    cfg.fused_control on/off (same process) and compare ms/round."""
+    import jax
+
+    fns, alive, quorum, build = _make(cfg)
+    one = build(
+        cfg,
+        offset_updates={p: [(0, 1)] for p in range(cfg.partitions)},
+        leader=0, term=1,
+    )
+    inp = jax.device_put(jax.tree.map(
+        lambda x: np.broadcast_to(x, (chain,) + x.shape).copy(), one
+    ))
+    # No log growth -> trim stays zero; stage it once per launch so the
+    # timed loop matches the sustained path's call shape exactly.
+    zero_trim = jax.device_put(np.zeros((cfg.partitions,), np.int32))
+    trims = [zero_trim] * launches
+    _sustained_warmup(fns, inp, alive, quorum, trims)
+    best = 0.0
+    for _ in range(windows):
+        rate, state = _sustained_window(
+            fns, inp, alive, quorum, trims, launches * chain
+        )
+        best = max(best, rate)  # rounds/s
+        del state
+    return best
+
+
+def _run_fusion_ab(chain: int = 8, launches: int = 240,
+                   control_launches: int = 240, windows: int = 2,
+                   shape: dict | None = None) -> dict:
+    """Same-process A/B of the two r5 levers (ISSUE 1 tentpole):
+    fused control and packed writes vs the legacy path, at the headline
+    shape unless overridden. Control-only rounds isolate the control
+    phase (target: 0.445 ms -> <=0.35 ms/round on the measuring host);
+    full rounds measure the end effect on committed appends/s. Each
+    variant runs its complete best-of-N windows in sequence within one
+    process (best-of-N absorbs additive noise the way the spmd-parity
+    A/B's alternation does, but slow drift BETWEEN variants — thermal,
+    background load — lands in the deltas: treat small cross-variant
+    differences as bounded by the run-to-run variance, not resolved).
+    `python profiles/control_ab.py` runs this standalone."""
+    from ripplemq_tpu.core.config import ALIGN, EngineConfig
+
+    base = dict(
+        partitions=1024, replicas=5, slots=12352, slot_bytes=128,
+        max_batch=256, read_batch=32, max_consumers=64,
+        max_offset_updates=8,
+    )
+    base.update(shape or {})
+    variants = {
+        "legacy": {},
+        "fused": dict(fused_control=True),
+        "packed": dict(packed_writes=True),
+        "fused_packed": dict(fused_control=True, packed_writes=True),
+    }
+    out = {"config": (f"P={base['partitions']} R={base['replicas']} "
+                      f"B={base['max_batch']} chain={chain} sustained")}
+    for name in ("legacy", "fused"):
+        cfg = EngineConfig(**base, **variants[name])
+        rate = _run_control_only(cfg, chain=chain,
+                                 launches=control_launches,
+                                 windows=windows)
+        out[f"control_ms_per_round_{name}"] = round(1e3 / rate, 4)
+    for name, kw in variants.items():
+        cfg = EngineConfig(**base, **kw)
+        rate = _run_sustained(cfg, chain=chain, launches=launches,
+                              windows=windows, verify=True)
+        out[f"sustained_appends_per_sec_{name}"] = round(rate, 1)
+    # Partial rounds are where packed writes move fewer bytes (a full
+    # B-row round's extent IS the full window): quarter-full batches,
+    # the bursty-broker shape, legacy vs both-levers.
+    partial = max(ALIGN, base["max_batch"] // 4)
+    for name in ("legacy", "fused_packed"):
+        cfg = EngineConfig(**base, **variants[name])
+        rate = _run_sustained(cfg, chain=chain, launches=launches,
+                              windows=windows, verify=True,
+                              batch_per_partition=partial)
+        out[f"partial_b{partial}_appends_per_sec_{name}"] = round(rate, 1)
+    out["control_speedup"] = round(
+        out["control_ms_per_round_legacy"]
+        / out["control_ms_per_round_fused"], 3)
+    out["sustained_speedup_fused_packed"] = round(
+        out["sustained_appends_per_sec_fused_packed"]
+        / out["sustained_appends_per_sec_legacy"], 3)
+    out[f"partial_b{partial}_speedup_fused_packed"] = round(
+        out[f"partial_b{partial}_appends_per_sec_fused_packed"]
+        / out[f"partial_b{partial}_appends_per_sec_legacy"], 3)
+    return out
 
 
 def _run_latency(cfg, submitters: int = 16,
@@ -929,6 +1040,10 @@ def main() -> None:
     )
     consume_rate = _run_consume(consume_cfg, consumers=32, rows_per_part=128)
     spmd = _run_spmd_parity()
+    # ISSUE 1 tentpole A/B: fused control + packed writes vs the legacy
+    # path, same process, headline shape (also runnable standalone:
+    # profiles/control_ab.py).
+    fusion_ab = _run_fusion_ab()
     e2e = _run_e2e()
 
     print(
@@ -951,6 +1066,7 @@ def main() -> None:
                 "operating_curve": curve,
                 "consume_msgs_per_sec": round(consume_rate, 1),
                 "spmd_parity": spmd,
+                "control_fusion_ab": fusion_ab,
                 "readback": "verified",
                 **e2e,
             }

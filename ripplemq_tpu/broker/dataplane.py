@@ -35,6 +35,7 @@ from ripplemq_tpu.core.config import ALIGN, ROW_HEADER as _HDR, EngineConfig
 from ripplemq_tpu.core.encode import (
     decode_entries_with_pos,
     pack_payload_rows,
+    row_extents,
     stamp_term,
 )
 from ripplemq_tpu.core.state import ReplicaState, StepInput, row_lens
@@ -822,6 +823,7 @@ class DataPlane:
             off_counts=np.zeros((P,), np.int32),
             leader=np.zeros((P,), np.int32),
             term=np.zeros((P,), np.int32),
+            extents=np.zeros((P,), np.int32),
         )
         alive = np.ones((P, cfg.replicas), bool)
         K = self.chain_depth
@@ -1099,7 +1101,8 @@ class DataPlane:
                 zero = self._zero_round_template()
                 pad_inp = StepInput(self._dummy_entries(), *zero,
                                     leader=self.leader.copy(),
-                                    term=self.term.copy())
+                                    term=self.term.copy(),
+                                    extents=zero[0])
                 while len(rounds) < self.chain_depth:
                     rounds.append((
                         pad_inp,
@@ -1325,6 +1328,10 @@ class DataPlane:
             off_counts=off_counts,
             leader=self.leader.copy(),
             term=self.term.copy(),
+            # Rows this round's write must cover (packed_writes clips
+            # the append DMA to this; boundary-padding rounds count
+            # their padding in `counts`, so the extent covers them too).
+            extents=row_extents(counts),
         )
         return inp, {"appends": round_appends, "offsets": round_offsets,
                      "bases": round_bases, "entries": blocks,

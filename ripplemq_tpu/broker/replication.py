@@ -43,6 +43,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 from ripplemq_tpu.broker.dataplane import NotCommittedError
@@ -315,7 +316,10 @@ class RoundReplicator:
                 try:
                     fut.result(timeout=0.05)
                     break
-                except TimeoutError:
+                # concurrent.futures.TimeoutError is a distinct class from
+                # the builtin before Python 3.11 — catching only the
+                # builtin let ack-poll timeouts escape as round failures.
+                except (TimeoutError, FuturesTimeoutError):
                     if not self.active():
                         raise FencedError("controller deposed (local metadata)")
                     if (

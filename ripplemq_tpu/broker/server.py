@@ -83,6 +83,7 @@ class _BarrierGate:
 
     def wait(self, timeout_s: float) -> None:
         from concurrent.futures import Future
+        from concurrent.futures import TimeoutError as FuturesTimeoutError
 
         with self._lock:
             fut = self._pending
@@ -94,7 +95,10 @@ class _BarrierGate:
                 ).start()
         try:
             fut.result(timeout=timeout_s)
-        except TimeoutError:
+        # Both classes: the gate can hit a result-wait timeout, or the
+        # fire thread can set a FuturesTimeoutError raised by a standby
+        # ack wait — pre-3.11 neither is the builtin TimeoutError.
+        except (TimeoutError, FuturesTimeoutError):
             raise NotCommittedError(
                 "read barrier timed out: leadership unconfirmed"
             ) from None

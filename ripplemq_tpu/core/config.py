@@ -10,6 +10,7 @@ SURVEY.md §7 "hard parts".
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 # Log slot alignment: every committed round advances the log end to a
 # multiple of ALIGN so that the append kernel's DMA windows land on TPU
@@ -28,6 +29,49 @@ ALIGN = 8
 # Embedding the header in the row keeps the data plane to ONE array and
 # the append to ONE DMA per (replica, partition) per round.
 ROW_HEADER = 8
+
+# Ring-stride aliasing hazard (PROFILE.md round-5 finding 2): when the
+# per-partition ring stride (slots + max_batch) * slot_bytes lands on or
+# near a power of two >= 2^20, the append kernel's strided partition DMAs
+# alias HBM channels and the measured write rate drops 25-35% (slots 8192
+# at SB 128 — stride 2^20 + 32 KiB — vs slots 8448/12352 in the same
+# process). The measured-bad stride sat 3.1% off the power of two, so the
+# "near" band is 1/16 relative.
+STRIDE_POW2_FLOOR = 1 << 20
+_STRIDE_REL_TOL = 16  # flag within pow2/16 of the power of two
+STRIDE_WARN_MIN_PARTITIONS = 64  # below this, too few concurrent
+#                                  strided streams to alias measurably
+
+
+def ring_stride_bytes(slots: int, max_batch: int, slot_bytes: int) -> int:
+    """Per-partition byte stride of the physical log array
+    [P, slots + max_batch, slot_bytes] (the ring plus its wrap margin)."""
+    return (slots + max_batch) * slot_bytes
+
+
+def stride_alias_hazard(slots: int, max_batch: int,
+                        slot_bytes: int) -> str | None:
+    """Non-None iff the ring stride lands on/near a >= 2^20 power of two
+    (the HBM-channel-aliasing shapes PROFILE.md r5 measured). Returns the
+    warning text so callers can warn, log, or assert on it."""
+    stride = ring_stride_bytes(slots, max_batch, slot_bytes)
+    if stride <= 0:
+        return None
+    lo = 1 << (stride.bit_length() - 1)
+    for pow2 in (lo, lo << 1):
+        if pow2 >= STRIDE_POW2_FLOOR and (
+            abs(stride - pow2) <= pow2 // _STRIDE_REL_TOL
+        ):
+            return (
+                f"ring stride {stride} B/partition "
+                f"((slots={slots} + max_batch={max_batch}) * "
+                f"slot_bytes={slot_bytes}) is within {100 / _STRIDE_REL_TOL:.1f}% "
+                f"of 2^{pow2.bit_length() - 1}; strided append DMAs at this "
+                f"shape alias HBM channels (measured 25-35% write-rate "
+                f"penalty, PROFILE.md r5). Nudge `slots` so the stride "
+                f"moves off the power of two."
+            )
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +93,18 @@ class EngineConfig:
     read_batch: int = 32         # RB — max entries per batch read
     max_consumers: int = 64      # C — consumer-offset table width
     max_offset_updates: int = 8  # U — max offset commits per partition/step
+    # Hot-path levers (PROFILE.md r5: the sustained engine is pinned by
+    # the balanced control and write phases — both must shrink to move).
+    # Each is independently A/B-able against the legacy path and
+    # bit-identical to it (tests/test_control_fusion.py):
+    fused_control: bool = False  # bookkeeping scalars as one [K, P] ctrl
+    #                              array updated by wide fused ops instead
+    #                              of per-field element-wise ops (local
+    #                              binding; shard_map fusion is a ROADMAP
+    #                              open item)
+    packed_writes: bool = False  # clip append DMA windows to the round's
+    #                              payload extent instead of always moving
+    #                              the full [B, SB] block
 
     def __post_init__(self) -> None:
         if self.replicas < 1:
@@ -63,6 +119,17 @@ class EngineConfig:
             raise ValueError(f"max_batch must be a multiple of {ALIGN}")
         if self.slots % ALIGN:
             raise ValueError(f"slots must be a multiple of {ALIGN}")
+        # The aliasing penalty comes from MANY concurrent strided
+        # partition DMAs hammering the same HBM channels; at small
+        # partition counts the effect is negligible (the shipped P=8
+        # example keeps its round numbers on purpose — see
+        # examples/cluster.yaml's sizing note), so only fan-out shapes
+        # warn.
+        if self.partitions >= STRIDE_WARN_MIN_PARTITIONS:
+            hazard = stride_alias_hazard(self.slots, self.max_batch,
+                                         self.slot_bytes)
+            if hazard is not None:
+                warnings.warn(hazard, UserWarning, stacklevel=2)
 
     @property
     def quorum(self) -> int:

@@ -14,8 +14,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from ripplemq_tpu.core.config import ROW_HEADER, EngineConfig
+from ripplemq_tpu.core.config import ALIGN, ROW_HEADER, EngineConfig
 from ripplemq_tpu.core.state import StepInput
+
+
+def row_extents(counts: np.ndarray) -> np.ndarray:
+    """Per-partition write extents (rows, ALIGN-rounded) from payload
+    counts — what the packed write path (EngineConfig.packed_writes)
+    needs to clip each append DMA to the bytes the round actually
+    carries. Host-side analogue of core.step._padded_advance."""
+    counts = np.asarray(counts, np.int32)
+    return ((counts + ALIGN - 1) // ALIGN * ALIGN).astype(np.int32)
 
 
 def pack_rows(
@@ -137,6 +146,7 @@ def build_step_input(
         off_counts=off_counts,
         leader=_per_partition(leader, -1),
         term=terms,
+        extents=row_extents(counts),
     )
 
 

@@ -66,6 +66,72 @@ class ReplicaState(NamedTuple):
     offsets: jax.Array      # int32 [P, C]     — replicated consumer offsets
 
 
+# Bookkeeping scalars stacked (in this order) into FusedReplicaState.ctrl.
+CTRL_FIELDS = ("log_end", "last_term", "current_term", "commit")
+CTRL_K = len(CTRL_FIELDS)
+
+
+class FusedReplicaState(NamedTuple):
+    """ReplicaState with the four per-partition bookkeeping vectors
+    stacked into ONE [K, P] int32 array (EngineConfig.fused_control).
+
+    Rationale (PROFILE.md r5 finding 3): the control phase's cost is
+    fusion-boundary overhead across dozens of small [R, P] element-wise
+    ops, not arithmetic. Carrying the scalars as one array lets the
+    round's bookkeeping advance as a handful of wide ops on one buffer
+    (core.step.replica_control_fused) and keeps the scan carry of a
+    chained launch to three leaves instead of six.
+
+    The named accessors mirror ReplicaState so host-side readers
+    (DataPlane._fetch_state, read paths, tests) work on either
+    representation; they are views, not extra buffers. Conversion in
+    both directions is exact (`fuse_state` / `unfuse_state`)."""
+
+    log_data: jax.Array     # uint8 [P, S+B, SB] — identical to ReplicaState
+    ctrl: jax.Array         # int32 [K, P]       — CTRL_FIELDS, stacked
+    offsets: jax.Array      # int32 [P, C]       — identical to ReplicaState
+
+    # A leading replica axis (engine-stacked state) moves ctrl to
+    # [R, K, P]; `...` keeps the accessors shape-agnostic.
+    @property
+    def log_end(self) -> jax.Array:
+        return self.ctrl[..., 0, :]
+
+    @property
+    def last_term(self) -> jax.Array:
+        return self.ctrl[..., 1, :]
+
+    @property
+    def current_term(self) -> jax.Array:
+        return self.ctrl[..., 2, :]
+
+    @property
+    def commit(self) -> jax.Array:
+        return self.ctrl[..., 3, :]
+
+
+def fuse_state(state: ReplicaState) -> FusedReplicaState:
+    """Stack the bookkeeping scalars into the fused layout (exact)."""
+    ctrl = jnp.stack(
+        [getattr(state, f) for f in CTRL_FIELDS], axis=-2
+    ).astype(jnp.int32)
+    return FusedReplicaState(
+        log_data=state.log_data, ctrl=ctrl, offsets=state.offsets
+    )
+
+
+def unfuse_state(state: FusedReplicaState) -> ReplicaState:
+    """Split the fused layout back into named fields (exact inverse)."""
+    return ReplicaState(
+        log_data=state.log_data,
+        log_end=state.log_end,
+        last_term=state.last_term,
+        current_term=state.current_term,
+        commit=state.commit,
+        offsets=state.offsets,
+    )
+
+
 class StepInput(NamedTuple):
     """One replication round's input (per partition).
 
@@ -87,6 +153,15 @@ class StepInput(NamedTuple):
     off_counts: jax.Array  # int32 [P]        — how many of U are valid
     leader: jax.Array      # int32 [P]        — replica id of partition leader (-1 = none)
     term: jax.Array        # int32 [P]        — leader's term (host/election-managed)
+    extents: jax.Array | None = None  # int32 [P] — rows of the [B, SB]
+    #                        window the write phase must cover this round
+    #                        (the host knows the payload extent at
+    #                        pack time; EngineConfig.packed_writes clips
+    #                        the append DMA to it — ops/append.py). The
+    #                        control phase clamps to [advance, B], so a
+    #                        missing/short extent can never under-write a
+    #                        committed round. None (pytree-empty) means
+    #                        "full window", the legacy write shape.
 
 
 class StepOutput(NamedTuple):
@@ -123,6 +198,7 @@ def empty_input(cfg: EngineConfig) -> StepInput:
         off_counts=jnp.zeros((P,), jnp.int32),
         leader=jnp.full((P,), -1, jnp.int32),
         term=jnp.zeros((P,), jnp.int32),
+        extents=jnp.zeros((P,), jnp.int32),
     )
 
 

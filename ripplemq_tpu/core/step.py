@@ -53,6 +53,7 @@ from jax import lax
 
 from ripplemq_tpu.core.config import ALIGN, EngineConfig
 from ripplemq_tpu.core.state import (
+    FusedReplicaState,
     ReplicaState,
     StepInput,
     StepOutput,
@@ -91,6 +92,39 @@ def _padded_advance(counts: jax.Array) -> jax.Array:
 class ControlOut(NamedTuple):
     out: StepOutput     # per-partition round results (replica-invariant)
     do_write: jax.Array  # bool [P] — this replica writes the round's block
+    extent: jax.Array    # int32 [P] — rows of the [B, SB] window the write
+    #                      phase covers (== B unless packed_writes clips
+    #                      it; replica-invariant — derived from the input)
+
+
+def _write_extent(cfg: EngineConfig, inp: StepInput,
+                  advance: jax.Array) -> jax.Array:
+    """Rows the write phase covers: the host-declared extent, ALIGN-
+    rounded and clamped to [advance, B] so a committed round's rows are
+    always covered no matter what the host fed. None extents (or a
+    config without packed writes) mean the full legacy window."""
+    B = cfg.max_batch
+    if not cfg.packed_writes or inp.extents is None:
+        return jnp.full_like(advance, B)
+    ext = _padded_advance(jnp.clip(inp.extents, 0, B))
+    return jnp.clip(ext, advance, B)
+
+
+def _blend_offsets(cfg: EngineConfig, state_offsets: jax.Array,
+                   inp: StepInput, do_write: jax.Array) -> jax.Array:
+    """Committed consumer-offset updates: blended (not scattered —
+    scatters row-serialize on TPU) into the [P, C] table; U is small and
+    static, so the update unrolls to U masked selects."""
+    U = cfg.max_offset_updates
+    C = cfg.max_consumers
+    off_counts = jnp.clip(inp.off_counts, 0, U)
+    new_offsets = state_offsets
+    cols = jnp.arange(C, dtype=jnp.int32)[None, :]         # [1, C]
+    for u in range(U):
+        apply_u = do_write & (u < off_counts)              # [P]
+        mask = (inp.off_slots[:, u : u + 1] == cols) & apply_u[:, None]
+        new_offsets = jnp.where(mask, inp.off_vals[:, u : u + 1], new_offsets)
+    return new_offsets
 
 
 def replica_control(
@@ -198,18 +232,9 @@ def replica_control(
     commit_target = jnp.where(do_write, base + advance, 0)
     new_commit = jnp.maximum(state.commit, commit_target)
 
-    # --- 5. committed consumer-offset updates: blended (not scattered —
-    # scatters row-serialize on TPU) into the [P, C] table; U is small and
-    # static, so the update unrolls to U masked selects.
-    U = cfg.max_offset_updates
-    C = cfg.max_consumers
-    off_counts = jnp.clip(inp.off_counts, 0, U)
-    new_offsets = state.offsets
-    cols = jnp.arange(C, dtype=jnp.int32)[None, :]         # [1, C]
-    for u in range(U):
-        apply_u = do_write & (u < off_counts)              # [P]
-        mask = (inp.off_slots[:, u : u + 1] == cols) & apply_u[:, None]
-        new_offsets = jnp.where(mask, inp.off_vals[:, u : u + 1], new_offsets)
+    # --- 5. committed consumer-offset updates (shared with the fused
+    # path — see _blend_offsets).
+    new_offsets = _blend_offsets(cfg, state.offsets, inp, do_write)
 
     new_state = state._replace(
         log_end=new_log_end,
@@ -224,7 +249,115 @@ def replica_control(
         committed=committed,
         commit=lax.pmax(new_commit, AXIS),
     )
-    return new_state, ControlOut(out, wrote_rows)
+    return new_state, ControlOut(out, wrote_rows, _write_extent(cfg, inp, advance))
+
+
+def replica_control_fused(
+    cfg: EngineConfig,
+    state: FusedReplicaState,
+    inp: StepInput,
+    rep_idx: jax.Array,
+    alive: jax.Array,
+    quorum: jax.Array | None = None,
+    trim: jax.Array | None = None,
+) -> tuple[FusedReplicaState, ControlOut]:
+    """replica_control on the stacked-ctrl state (EngineConfig.
+    fused_control), bit-identical to the legacy path by construction
+    (asserted across scenarios in tests/test_control_fusion.py).
+
+    What actually shrinks (PROFILE.md r5 finding 3 — the control phase
+    is fusion-boundary overhead, not arithmetic):
+    - the two leader broadcasts (prevLogIndex + prevLogTerm) ride ONE
+      [2, P] psum instead of two [P] psums — under shard_map that is one
+      collective instead of two, under vmap one fused reduction;
+    - the four bookkeeping advances collapse into ONE [K, P] select on
+      one buffer instead of four where/maximum ops on four buffers
+      (each a separate XLA fusion in the scanned chain body);
+    - the scan carry of a chained launch is three leaves, not six.
+
+    Equivalence notes (each update is the exact legacy expression, just
+    restacked): `maximum(x, y)` == `where(y > x, y, x)` bitwise for
+    int32, which rewrites current_term/commit as selects; log_end and
+    last_term keep their wrote_rows selects unchanged.
+    """
+    S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
+    P = cfg.partitions
+    if quorum is None:
+        quorum = jnp.full((P,), cfg.quorum, jnp.int32)
+    if trim is None:
+        trim = jnp.zeros((P,), jnp.int32)
+
+    ctrl = state.ctrl                                     # [K, P]
+    log_end, last_term = ctrl[0], ctrl[1]
+    current_term, commit = ctrl[2], ctrl[3]
+
+    counts = jnp.clip(inp.counts, 0, B)
+    advance = _padded_advance(counts)                    # [P]
+
+    alive = _normalize_alive(alive, P, R)                # [P, R]
+    self_alive = alive[:, rep_idx]                       # [P]
+    leader_known = (inp.leader >= 0) & (inp.leader < R)  # [P]
+    is_leader = (inp.leader == rep_idx) & leader_known   # [P]
+    leader_alive = jnp.where(
+        leader_known,
+        jnp.take_along_axis(
+            alive, jnp.clip(inp.leader, 0, R - 1)[:, None], axis=1
+        )[:, 0],
+        False,
+    )
+
+    # --- 1. leader's pre-append log end + tail term: ONE stacked psum.
+    lead_mask = (is_leader & self_alive)[None, :]         # [1, P]
+    led = lax.psum(
+        jnp.where(lead_mask, ctrl[0:2], jnp.zeros_like(ctrl[0:2])), AXIS
+    )                                                     # [2, P]
+    base, leader_last_term = led[0], led[1]
+
+    # --- 2. ack (identical predicate to the legacy path).
+    term_ok = inp.term >= current_term
+    log_match = (log_end == base) & (
+        (base == 0) | (last_term == leader_last_term)
+    )
+    capacity_ok = (counts == 0) | (base + B - trim <= S)
+    has_work = (counts > 0) | (inp.off_counts > 0)
+    ack = (
+        self_alive
+        & leader_alive
+        & term_ok
+        & log_match
+        & capacity_ok
+        & has_work
+    )  # [P]
+
+    # --- 3. ballot before any write.
+    votes = lax.psum(ack.astype(jnp.int32), AXIS)          # [P]
+    committed = votes >= quorum                            # [P]
+    do_write = ack & committed                             # [P]
+
+    # --- 4. the four scalar advances as ONE wide select on the stacked
+    # buffer (see the docstring's equivalence notes).
+    wrote_rows = do_write & (advance > 0)
+    adv_target = base + advance
+    conds = jnp.stack([
+        wrote_rows,                                        # log_end
+        wrote_rows,                                        # last_term
+        inp.term > current_term,                           # current_term
+        do_write & (adv_target > commit),                  # commit
+    ])                                                     # [K, P] bool
+    cands = jnp.stack([adv_target, inp.term, inp.term, adv_target])
+    new_ctrl = jnp.where(conds, cands, ctrl)               # [K, P]
+
+    # --- 5. committed consumer-offset updates (shared helper).
+    new_offsets = _blend_offsets(cfg, state.offsets, inp, do_write)
+
+    new_state = state._replace(ctrl=new_ctrl, offsets=new_offsets)
+    out = StepOutput(
+        base=base,
+        votes=votes,
+        committed=committed,
+        commit=lax.pmax(new_ctrl[3], AXIS),
+    )
+    return new_state, ControlOut(out, wrote_rows, _write_extent(cfg, inp, advance))
 
 
 def replica_step(
@@ -272,6 +405,43 @@ def vote_step(
     (NodeOptions.setElectionTimeoutMs — reference
     PartitionRaftServer.java:85 — with timeouts host-vectorized).
     """
+    new_term, elected, votes = _vote_core(
+        cfg, state.log_end, state.last_term, state.current_term,
+        cand, cand_term, rep_idx, alive, quorum,
+    )
+    return state._replace(current_term=new_term), elected, votes
+
+
+def vote_step_fused(
+    cfg: EngineConfig,
+    state: FusedReplicaState,
+    cand: jax.Array,
+    cand_term: jax.Array,
+    rep_idx: jax.Array,
+    alive: jax.Array,
+    quorum: jax.Array | None = None,
+) -> tuple[FusedReplicaState, jax.Array, jax.Array]:
+    """vote_step on the stacked-ctrl state: same ballot core, the term
+    grant lands in ctrl row 2."""
+    new_term, elected, votes = _vote_core(
+        cfg, state.ctrl[0], state.ctrl[1], state.ctrl[2],
+        cand, cand_term, rep_idx, alive, quorum,
+    )
+    new_ctrl = state.ctrl.at[2].set(new_term)
+    return state._replace(ctrl=new_ctrl), elected, votes
+
+
+def _vote_core(
+    cfg: EngineConfig,
+    log_end: jax.Array,
+    last_term: jax.Array,
+    current_term: jax.Array,
+    cand: jax.Array,
+    cand_term: jax.Array,
+    rep_idx: jax.Array,
+    alive: jax.Array,
+    quorum: jax.Array | None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     R = cfg.replicas
     alive = _normalize_alive(alive, cfg.partitions, R)  # [P, R]
     if quorum is None:
@@ -285,20 +455,20 @@ def vote_step(
         False,
     )
 
-    my_last_term = state.last_term
-    c_end = _bcast_from_leader(state.log_end, is_cand & self_alive)
+    my_last_term = last_term
+    c_end = _bcast_from_leader(log_end, is_cand & self_alive)
     c_last_term = _bcast_from_leader(my_last_term, is_cand & self_alive)
 
     up_to_date = (c_last_term > my_last_term) | (
-        (c_last_term == my_last_term) & (c_end >= state.log_end)
+        (c_last_term == my_last_term) & (c_end >= log_end)
     )
-    grant = electing & self_alive & cand_alive & (cand_term > state.current_term) & up_to_date
+    grant = electing & self_alive & cand_alive & (cand_term > current_term) & up_to_date
 
     votes = lax.psum(grant.astype(jnp.int32), AXIS)
     elected = votes >= quorum
 
-    new_term = jnp.where(grant, cand_term, state.current_term)
-    return state._replace(current_term=new_term), elected, votes
+    new_term = jnp.where(grant, cand_term, current_term)
+    return new_term, elected, votes
 
 
 def read_batch(

@@ -28,6 +28,13 @@ Semantics contract (shared with the XLA fallback, asserted in tests):
   cfg.slots + the B-row wrap margin; see core.state) whenever
   do_write[r, p]. The control phase's trim-gated capacity rule keeps
   live rows out of the window's reclaimable tail.
+- packed mode (`extents` given — EngineConfig.packed_writes): the
+  written region shrinks from the full B rows to the partition's
+  extent CLASS (power-of-two ALIGN-row blocks >= the ALIGN-rounded
+  extent; see the packed-extents section below). Rows between the
+  class and B keep their prior bytes — they are beyond the round's
+  advance, so nothing below `commit` can ever read them. Both backends
+  apply the identical class rule and stay bit-identical to each other.
 """
 
 from __future__ import annotations
@@ -49,17 +56,58 @@ def _pick_k(P: int, target: int = 8) -> int:
     return max(1, k)
 
 
-def _append_pallas(log_data, entries, base, do_write, *, interpret=False):
+# --------------------------------------------------------- packed extents
+#
+# Length-aware write packing (EngineConfig.packed_writes): instead of
+# always moving the full [B, SB] window, clip the copy to the round's
+# payload extent. Pallas DMAs need static shapes, so the dynamic extent
+# is rounded UP to a power-of-two class of ALIGN-row blocks — one
+# predicated DMA of the matching class fires per window (never more
+# issues than the legacy path; at most 2x the true extent in bytes,
+# still proportionally fewer HBM bytes for small rounds). The XLA
+# fallback applies the SAME class rule so both backends stay
+# bit-identical, packed vs packed.
+
+
+def _packed_classes(BA: int) -> list[int]:
+    """Ascending copy-size classes in ALIGN-row blocks: powers of two
+    plus the full window (BA itself, whether or not it is a power)."""
+    sizes = set()
+    s = 1
+    while s < BA:
+        sizes.add(s)
+        s *= 2
+    sizes.add(BA)
+    return sorted(sizes)
+
+
+def _class_roundup(eb, BA: int):
+    """Smallest class >= eb (works on scalars and vectors; eb is in
+    ALIGN-row blocks, already clipped to [0, BA])."""
+    classes = _packed_classes(BA)
+    pb = jnp.full_like(eb, classes[-1])
+    for s in reversed(classes):
+        pb = jnp.where(eb <= jnp.int32(s), jnp.int32(s), pb)
+    return pb
+
+
+def _extent_blocks(extents, B: int):
+    """Host row extents [P] -> ALIGN-row block counts [P], clipped."""
+    return (jnp.clip(extents.astype(jnp.int32), 0, B) + ALIGN - 1) // ALIGN
+
+
+def _append_pallas(log_data, entries, base, do_write, *, extents=None,
+                   interpret=False):
     """Dense write = the active-set kernel with every partition listed
     (ids = arange(P)); one kernel to maintain."""
     P = log_data.shape[1]
     return _append_active_pallas(
         log_data, entries, jnp.arange(P, dtype=jnp.int32), base, do_write,
-        interpret=interpret,
+        extents=extents, interpret=interpret,
     )
 
 
-def append_rows_xla(log_data, entries, base, do_write):
+def append_rows_xla(log_data, entries, base, do_write, extents=None):
     """XLA fallback (row scatter) with identical semantics.
 
     Handles both the per-replica shape ([P, S, SB] log with [P] do_write —
@@ -68,7 +116,8 @@ def append_rows_xla(log_data, entries, base, do_write):
     scatter over every partition."""
     P = log_data.shape[-3]
     return append_rows_active_xla(
-        log_data, entries, jnp.arange(P, dtype=jnp.int32), base, do_write
+        log_data, entries, jnp.arange(P, dtype=jnp.int32), base, do_write,
+        extents,
     )
 
 
@@ -139,8 +188,80 @@ def _kernel_active(Ka: int, BA: int, ids_ref, base_ref, dw_ref, entries_ref,
                 copy(k, a).wait()
 
 
+def _kernel_active_packed(Ka: int, BA: int, ids_ref, base_ref, dw_ref,
+                          eb_ref, entries_ref, log_in, log_out, sems):
+    """_kernel_active with the copy region clipped to the partition's
+    extent class (see the packed-extents section above). Identical
+    structure: a uniform fast path (one strided DMA for a whole block of
+    consecutive lockstep partitions — now additionally requiring one
+    shared extent class) and a per-entry path. Every copy remains ONE
+    DMA start per window; the class predicates are scalar-core compares,
+    so packed rounds never issue more DMAs than the legacy kernel."""
+    r = pl.program_id(0)
+    c = pl.program_id(1)
+    classes = _packed_classes(BA)
+
+    def pblocks(p):
+        return _class_roundup(jnp.clip(eb_ref[p], 1, BA), BA)
+
+    def active(a):
+        p = jnp.maximum(ids_ref[a], 0)
+        return (ids_ref[a] >= 0) & (dw_ref[r, p] != 0)
+
+    def copy(k, a, s):
+        p = jnp.maximum(ids_ref[a], 0)
+        b = base_ref[p] // ALIGN
+        return pltpu.make_async_copy(
+            entries_ref.at[k, pl.ds(0, s)],
+            log_out.at[r, p, pl.ds(b, s), :, :],
+            sems.at[k],
+        )
+
+    p0 = ids_ref[c * Ka]
+    b0 = base_ref[jnp.maximum(p0, 0)] // ALIGN
+    pb0 = pblocks(jnp.maximum(p0, 0))
+    uniform = jnp.bool_(Ka > 1)
+    for k in range(Ka):
+        a = c * Ka + k
+        pk = ids_ref[a]
+        pkc = jnp.maximum(pk, 0)
+        uniform &= (pk == p0 + k) & active(a)
+        uniform &= base_ref[pkc] // ALIGN == b0
+        uniform &= pblocks(pkc) == pb0
+
+    for s in classes:
+
+        @pl.when(uniform & (pb0 == s))
+        def _(s=s):
+            cp = pltpu.make_async_copy(
+                entries_ref.at[:, pl.ds(0, s)],
+                log_out.at[r, pl.ds(p0, Ka), pl.ds(b0, s), :, :],
+                sems.at[0],
+            )
+            cp.start()
+            cp.wait()
+
+    @pl.when(~uniform)
+    def _():
+        for k in range(Ka):  # static unroll; Ka and the class set are small
+            a = c * Ka + k
+            for s in classes:
+
+                @pl.when(active(a) & (pblocks(jnp.maximum(ids_ref[a], 0)) == s))
+                def _(k=k, a=a, s=s):
+                    copy(k, a, s).start()
+
+        for k in range(Ka):
+            a = c * Ka + k
+            for s in classes:
+
+                @pl.when(active(a) & (pblocks(jnp.maximum(ids_ref[a], 0)) == s))
+                def _(k=k, a=a, s=s):
+                    copy(k, a, s).wait()
+
+
 def _append_active_pallas(log_data, entries, slot_ids, base, do_write, *,
-                          interpret=False):
+                          extents=None, interpret=False):
     R, P, S, SB = log_data.shape
     A, B = entries.shape[0], entries.shape[1]
     BA = B // ALIGN
@@ -148,9 +269,17 @@ def _append_active_pallas(log_data, entries, slot_ids, base, do_write, *,
     log_v = log_data.reshape(R, P, S // ALIGN, ALIGN, SB)
     entries_v = entries.reshape(A, BA, ALIGN, SB)
     ids = jnp.where(slot_ids >= 0, jnp.clip(slot_ids, 0, P - 1), -1)
-    kernel = functools.partial(_kernel_active, Ka, BA)
+    packed = extents is not None
+    if packed:
+        kernel = functools.partial(_kernel_active_packed, Ka, BA)
+        scalars = (ids, base, do_write.astype(jnp.int32),
+                   _extent_blocks(extents, B))
+    else:
+        kernel = functools.partial(_kernel_active, Ka, BA)
+        scalars = (ids, base, do_write.astype(jnp.int32))
+    n_scalar = len(scalars)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,  # slot_ids, base, do_write
+        num_scalar_prefetch=n_scalar,  # ids, base, do_write[, ext blocks]
         grid=(R, A // Ka),
         in_specs=[
             pl.BlockSpec((Ka, BA, ALIGN, SB), lambda r, c, *_: (c, 0, 0, 0)),
@@ -163,33 +292,41 @@ def _append_active_pallas(log_data, entries, slot_ids, base, do_write, *,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(log_v.shape, log_v.dtype),
-        # scalar-prefetch args count: ids=0, base=1, do_write=2,
-        # entries=3, log=4.
-        input_output_aliases={4: 0},
+        # input index = scalar-prefetch args, then entries, then log.
+        input_output_aliases={n_scalar + 1: 0},
         interpret=interpret,
-    )(ids, base, do_write.astype(jnp.int32), entries_v, log_v)
+    )(*scalars, entries_v, log_v)
     return out.reshape(R, P, S, SB)
 
 
-def append_rows_active_xla(log_data, entries, slot_ids, base, do_write):
+def append_rows_active_xla(log_data, entries, slot_ids, base, do_write,
+                           extents=None):
     """XLA fallback for the active-set write: scatter entries[a]'s rows
-    into partition slot_ids[a] (per replica)."""
+    into partition slot_ids[a] (per replica). `extents` (packed mode)
+    clips each window to the partition's extent class — the same rule as
+    the packed Pallas kernel, so the two stay bit-identical."""
     if log_data.ndim == 4:
         return jax.vmap(append_rows_active_xla,
-                        in_axes=(0, None, None, None, 0))(
-            log_data, entries, slot_ids, base, do_write
+                        in_axes=(0, None, None, None, 0, None))(
+            log_data, entries, slot_ids, base, do_write, extents
         )
     P, S, SB = log_data.shape
     A, B = entries.shape[0], entries.shape[1]
     ids = jnp.clip(slot_ids, 0, P - 1)
     write = (slot_ids >= 0) & jnp.take(do_write, ids)          # [A]
     rows = jnp.arange(B, dtype=jnp.int32)[None, :]             # [1, B]
-    ridx = jnp.where(write[:, None], jnp.take(base, ids)[:, None] + rows, S)
+    in_window = write[:, None]
+    if extents is not None:
+        eb = jnp.clip(_extent_blocks(extents, B), 1, B // ALIGN)
+        rows_lim = _class_roundup(eb, B // ALIGN) * ALIGN      # [P]
+        in_window = in_window & (rows < jnp.take(rows_lim, ids)[:, None])
+    ridx = jnp.where(in_window, jnp.take(base, ids)[:, None] + rows, S)
     pidx = jnp.broadcast_to(ids[:, None], (A, B))
     return log_data.at[pidx, ridx].set(entries, mode="drop")
 
 
 def append_rows_active(log_data, entries, slot_ids, base, do_write, *,
+                       extents=None,
                        use_pallas: bool | None = None,
                        interpret: bool = False):
     """Active-set write phase: entries [A, B, SB] carry only the A
@@ -201,24 +338,30 @@ def append_rows_active(log_data, entries, slot_ids, base, do_write, *,
     and input transfer rides every dispatch.
 
     Same contracts as append_rows (`base` physical, ALIGN-aligned;
-    full-B windows; do_write [R, P]); additionally each partition
-    appears at most once in slot_ids per round."""
+    full-B windows — or extent-class windows when `extents` is given;
+    do_write [R, P]); additionally each partition appears at most once
+    in slot_ids per round."""
     SB = log_data.shape[-1]
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu" and SB % 128 == 0
     if use_pallas or interpret:
         return _append_active_pallas(log_data, entries, slot_ids, base,
-                                     do_write, interpret=interpret)
-    return append_rows_active_xla(log_data, entries, slot_ids, base, do_write)
+                                     do_write, extents=extents,
+                                     interpret=interpret)
+    return append_rows_active_xla(log_data, entries, slot_ids, base,
+                                  do_write, extents)
 
 
-def append_rows(log_data, entries, base, do_write, *, use_pallas: bool | None = None,
+def append_rows(log_data, entries, base, do_write, *, extents=None,
+                use_pallas: bool | None = None,
                 interpret: bool = False):
     """Dispatch: Pallas kernel on TPU, XLA scatter elsewhere.
 
     Inputs: log_data [R, P, S, SB] (donated/aliased in place on the pallas
     path), entries [P, B, SB] packed rows, base [P] (leader log end,
-    replica-invariant, ALIGN-aligned), do_write [R, P] bool.
+    replica-invariant, ALIGN-aligned), do_write [R, P] bool, extents [P]
+    rows (packed mode: clip each window to the partition's extent class;
+    None = full legacy windows).
     """
     SB = log_data.shape[-1]
     if use_pallas is None:
@@ -227,5 +370,5 @@ def append_rows(log_data, entries, base, do_write, *, use_pallas: bool | None = 
         use_pallas = jax.default_backend() == "tpu" and SB % 128 == 0
     if use_pallas or interpret:
         return _append_pallas(log_data, entries, base, do_write,
-                              interpret=interpret)
-    return append_rows_xla(log_data, entries, base, do_write)
+                              extents=extents, interpret=interpret)
+    return append_rows_xla(log_data, entries, base, do_write, extents)

@@ -25,7 +25,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ripplemq_tpu.core.config import EngineConfig
-from ripplemq_tpu.core.state import ReplicaState, StepInput, StepOutput, init_state
+from ripplemq_tpu.core.state import (
+    ReplicaState,
+    StepInput,
+    StepOutput,
+    fuse_state,
+    init_state,
+    unfuse_state,
+)
 from ripplemq_tpu.core import step as core_step
 from ripplemq_tpu.ops.append import append_rows, append_rows_active
 
@@ -96,17 +103,36 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
     rep_idx = jnp.arange(R, dtype=jnp.int32)
     default_quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
 
+    # cfg.fused_control swaps the control phase AND the state layout: the
+    # bookkeeping scalars ride one stacked [R, K, P] ctrl array
+    # (core.state.FusedReplicaState) advanced by wide fused ops
+    # (core.step.replica_control_fused). Bit-identical semantics either
+    # way (tests/test_control_fusion.py); the read paths work on both
+    # layouts through FusedReplicaState's named accessors.
+    fused = cfg.fused_control
+    ctrl_fn = (core_step.replica_control_fused if fused
+               else core_step.replica_control)
+    vote_fn = core_step.vote_step_fused if fused else core_step.vote_step
+
     @jax.jit
     def _init():
         one = init_state(cfg)
+        if fused:
+            one = fuse_state(one)
         return jax.tree.map(lambda x: jnp.broadcast_to(x, (R,) + x.shape).copy(), one)
 
     vctrl = jax.vmap(
-        functools.partial(core_step.replica_control, cfg),
+        functools.partial(ctrl_fn, cfg),
         in_axes=(0, None, 0, None, None, None),
         axis_name=core_step.AXIS,
     )
     default_trim = jnp.zeros((cfg.partitions,), jnp.int32)
+
+    def _ext(ctl):
+        # Packed write windows (cfg.packed_writes): the control phase
+        # derived the replica-invariant extent; None keeps the legacy
+        # full-window kernels byte-for-byte untouched.
+        return ctl.extent[0] if cfg.packed_writes else None
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _step_j(state, inp: StepInput, alive, quorum, trim):
@@ -116,7 +142,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
         new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum, trim)
         log_data = append_rows(
             state.log_data, inp.entries, ctl.out.base[0] % cfg.slots,
-            ctl.do_write
+            ctl.do_write, extents=_ext(ctl)
         )
         new_state = new_state._replace(log_data=log_data)
         # outputs are replica-invariant after the psum; take replica 0's copy
@@ -144,7 +170,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
             new_st, ctl = vctrl(st, inp, rep_idx, alive, quorum, trim)
             log = append_rows(
                 st.log_data, inp.entries, ctl.out.base[0] % cfg.slots,
-                ctl.do_write
+                ctl.do_write, extents=_ext(ctl)
             )
             return (
                 new_st._replace(log_data=log),
@@ -169,7 +195,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
         new_state, ctl = vctrl(state, inp, rep_idx, alive, quorum, trim)
         log_data = append_rows_active(
             state.log_data, entries_c, slot_ids,
-            ctl.out.base[0] % cfg.slots, ctl.do_write
+            ctl.out.base[0] % cfg.slots, ctl.do_write, extents=_ext(ctl)
         )
         new_state = new_state._replace(log_data=log_data)
         return new_state, jax.tree.map(lambda x: x[0], ctl.out)
@@ -188,7 +214,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
             new_st, ctl = vctrl(st, inp, rep_idx, alive, quorum, trim)
             log = append_rows_active(
                 st.log_data, ec, ids, ctl.out.base[0] % cfg.slots,
-                ctl.do_write
+                ctl.do_write, extents=_ext(ctl)
             )
             return (
                 new_st._replace(log_data=log),
@@ -205,7 +231,7 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
             default_trim if trim is None else trim)
 
     vvote = jax.vmap(
-        functools.partial(core_step.vote_step, cfg),
+        functools.partial(vote_fn, cfg),
         in_axes=(0, None, None, 0, None, None),
         axis_name=core_step.AXIS,
     )
@@ -249,17 +275,26 @@ def make_local_fns(cfg: EngineConfig) -> LocalEngineFns:
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def _resync_fn(state, src, dst, part_mask):
+        if fused:
+            # _resync's masking assumes [R, P, ...] leaves; the fused
+            # ctrl leaf is [R, K, P]. Resync is the rare recovery path,
+            # so round-trip through the named layout instead of teaching
+            # the masking about the stacked axis.
+            return fuse_state(
+                _resync(cfg, unfuse_state(state), src, dst, part_mask)
+            )
         return _resync(cfg, state, src, dst, part_mask)
 
-    def _init_from(image: ReplicaState) -> ReplicaState:
+    def _init_from(image: ReplicaState):
         """Install a recovered single-replica image on every replica slot
         (all replicas are identical post-commit — only committed rounds
         are ever persisted)."""
         import numpy as np
-        return jax.tree.map(
+        full = jax.tree.map(
             lambda x: jnp.asarray(np.broadcast_to(np.asarray(x), (R,) + np.asarray(x).shape)),
             image,
         )
+        return fuse_state(full) if fused else full
 
     return LocalEngineFns(_init, _step, _step_many, _step_sparse,
                           _step_many_sparse, _vote, _read, _read_many,
@@ -285,7 +320,11 @@ def _state_specs(cfg: EngineConfig) -> ReplicaState:
 
 def _input_specs() -> StepInput:
     """Inputs carry no replica axis: XLA's data distribution replicates
-    them over the replica mesh axis (this IS the AppendEntries fan-out)."""
+    them over the replica mesh axis (this IS the AppendEntries fan-out).
+    extents is always present here: None extents are pytree-empty and
+    would be a treedef mismatch against the compiled specs, so the spmd
+    wrappers fill missing extents with the full window first
+    (_fill_extents)."""
     return StepInput(
         entries=P("part", None, None),
         counts=P("part"),
@@ -294,6 +333,7 @@ def _input_specs() -> StepInput:
         off_counts=P("part"),
         leader=P("part"),
         term=P("part"),
+        extents=P("part"),
     )
 
 
@@ -315,6 +355,20 @@ def _smap(f, mesh, in_specs, out_specs):
 def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     R = cfg.replicas
     part_shards = mesh.shape["part"]
+    if cfg.fused_control:
+        # Control fusion under shard_map needs fused state specs plus a
+        # fused resync/fetch surface across processes — a ROADMAP open
+        # item. The flag is a perf hint with identical semantics, so the
+        # spmd binding keeps the legacy control phase rather than
+        # refusing to build. (packed_writes IS honored here.)
+        import warnings
+
+        warnings.warn(
+            "fused_control is not yet implemented for the spmd binding; "
+            "using the legacy control phase (same semantics)",
+            UserWarning,
+            stacklevel=2,
+        )
     if mesh.shape["replica"] != R:
         raise ValueError(
             f"mesh replica axis {mesh.shape['replica']} != cfg.replicas {R}"
@@ -344,6 +398,17 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     default_quorum = jnp.full((cfg.partitions,), cfg.quorum, jnp.int32)
 
     default_trim = jnp.zeros((cfg.partitions,), jnp.int32)
+
+    def _fill_extents(inp: StepInput) -> StepInput:
+        """Hand-built inputs may leave extents=None (pytree-empty); the
+        compiled specs carry a per-part extents shard, so fill with the
+        full window (== the legacy write shape). Chained inputs carry
+        the leading chain axis on every leaf, counts included."""
+        if inp.extents is not None:
+            return inp
+        return inp._replace(
+            extents=jnp.full(inp.counts.shape, cfg.max_batch, jnp.int32)
+        )
 
     def _gather_part(tree):
         """Replicate per-shard [P_local] outputs to full [P] on every
@@ -375,7 +440,8 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         # Write phase on this device's [1, P_local, S+B, SB] ring block.
         log_data = append_rows(
             st.log_data[None], inp.entries, ctl.out.base % cfg.slots,
-            ctl.do_write[None]
+            ctl.do_write[None],
+            extents=ctl.extent if cfg.packed_writes else None,
         )
         new_st = new_st._replace(log_data=log_data[0])
         # out is psum-replicated over "replica"; gather it over "part".
@@ -395,7 +461,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
                             trim)
 
     def _step(state, inp, alive, quorum=None, trim=None):
-        return _step_j(state, inp, alive,
+        return _step_j(state, _fill_extents(inp), alive,
                        default_quorum if quorum is None else quorum,
                        default_trim if trim is None else trim)
 
@@ -427,7 +493,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
                                  quorum, trim)
 
     def _step_many(state, inputs, alive, quorum=None, trim=None):
-        return _step_many_j(state, inputs, alive,
+        return _step_many_j(state, _fill_extents(inputs), alive,
                             default_quorum if quorum is None else quorum,
                             default_trim if trim is None else trim)
 
@@ -449,7 +515,8 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         )
         log_data = append_rows_active(
             st.log_data[None], entries_c, _local_ids(slot_ids),
-            ctl.out.base % cfg.slots, ctl.do_write[None]
+            ctl.out.base % cfg.slots, ctl.do_write[None],
+            extents=ctl.extent if cfg.packed_writes else None,
         )
         new_st = new_st._replace(log_data=log_data[0])
         return _expand(new_st), _gather_part(ctl.out)
@@ -469,7 +536,8 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
 
     def _step_sparse(state, inp, entries_c, slot_ids, alive, quorum=None,
                      trim=None):
-        return _step_sparse_j(state, inp, entries_c, slot_ids, alive,
+        return _step_sparse_j(state, _fill_extents(inp), entries_c, slot_ids,
+                              alive,
                               default_quorum if quorum is None else quorum,
                               default_trim if trim is None else trim)
 
@@ -501,7 +569,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     def _step_many_sparse(state, inputs, entries_c, slot_ids, alive,
                           quorum=None, trim=None):
         return _step_many_sparse_j(
-            state, inputs, entries_c, slot_ids, alive,
+            state, _fill_extents(inputs), entries_c, slot_ids, alive,
             default_quorum if quorum is None else quorum,
             default_trim if trim is None else trim)
 

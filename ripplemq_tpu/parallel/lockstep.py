@@ -104,8 +104,12 @@ class LockstepController:
         # Workers build their engine from this exact shape (no local op
         # to overlap: configure launches nothing on the mesh).
         with self._lock:
+            # bools stay bools (fused_control/packed_writes) so the
+            # worker rebuilds the EXACT EngineConfig — a mesh whose
+            # processes disagree on the compiled program deadlocks.
             futs = self._send("configure", [
-                {k: int(v) for k, v in cfg.__dict__.items()},
+                {k: (bool(v) if isinstance(v, bool) else int(v))
+                 for k, v in cfg.__dict__.items()},
                 int(part_shards),
             ])
         self._check(futs)
@@ -285,7 +289,10 @@ class LockstepWorker:
             from ripplemq_tpu.parallel.mesh import make_mesh
 
             cfg_dict, part_shards = args
-            cfg = EngineConfig(**{k: int(v) for k, v in cfg_dict.items()})
+            cfg = EngineConfig(**{
+                k: (v if isinstance(v, bool) else int(v))
+                for k, v in cfg_dict.items()
+            })
             mesh = make_mesh(cfg.replicas, int(part_shards))
             self._fns = make_spmd_fns(cfg, mesh)
             self._cfg = cfg

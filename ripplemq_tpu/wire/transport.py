@@ -24,7 +24,10 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+# On Python < 3.11 concurrent.futures.TimeoutError is NOT the builtin
+# TimeoutError, so Future.result timeouts must be caught as both.
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 from ripplemq_tpu.wire import codec
@@ -351,7 +354,7 @@ class TcpClient(Transport):
         fut = self.call_async(addr, request)
         try:
             return fut.result(timeout=timeout)
-        except TimeoutError:
+        except (TimeoutError, FuturesTimeoutError):
             # Drop the pending entry: the connection may stay alive for a
             # long time, and abandoned futures must not accumulate.
             with fut._rmq_conn.pending_lock:

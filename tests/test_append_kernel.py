@@ -175,6 +175,153 @@ def test_pallas_uniform_fast_path_matches_xla():
     np.testing.assert_array_equal(got, want)
 
 
+# ------------------------------------------------------------ packed writes
+#
+# EngineConfig.packed_writes: the copy region is clipped to the round's
+# extent, rounded UP to a power-of-two class of ALIGN-row blocks (both
+# backends apply the same rule — ops/append.py packed-extents section).
+# The packed Pallas kernel must stay bit-identical to the packed XLA
+# fallback on the FULL log; against the unpacked reference, rows below
+# the extent class must match and rows above it must be untouched.
+
+def _packed_rows_ref(extent, B):
+    """Python reference of the class rule: smallest power-of-two block
+    count >= ceil(extent/ALIGN), clamped to [1, B/ALIGN], in rows."""
+    BA = B // ALIGN
+    eb = min(max(-(-int(extent) // ALIGN), 1), BA)
+    s = 1
+    while s < eb:
+        s *= 2
+    return min(s, BA) * ALIGN
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_packed_pallas_matches_packed_xla_randomized(seed):
+    rng = np.random.default_rng(seed)
+    log, entries, base, do_write = rand_case(rng)
+    P, B = entries.shape[0], entries.shape[1]
+    extents = (rng.integers(0, B // ALIGN + 1, size=(P,)) * ALIGN).astype(
+        np.int32
+    )
+    got = np.asarray(_append_pallas(
+        log, entries, base, do_write, extents=extents, interpret=True
+    ))
+    want = np.asarray(
+        append_rows_xla(log, entries, base, do_write, extents)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_packed_writes_prefix_and_untouched_tail(seed):
+    """Packed output == unpacked output on rows below each partition's
+    extent class, and == the PRIOR log bytes above it (the packed mode's
+    whole point: those bytes are never moved)."""
+    rng = np.random.default_rng(100 + seed)
+    log, entries, base, do_write = rand_case(rng)
+    P, B = entries.shape[0], entries.shape[1]
+    extents = (rng.integers(1, B // ALIGN + 1, size=(P,)) * ALIGN).astype(
+        np.int32
+    )
+    packed = np.asarray(_append_pallas(
+        log, entries, base, do_write, extents=extents, interpret=True
+    ))
+    dense = np.asarray(append_rows_xla(log, entries, base, do_write))
+    R = log.shape[0]
+    for r in range(R):
+        for p in range(P):
+            b, rows = int(base[p]), _packed_rows_ref(extents[p], B)
+            if do_write[r, p]:
+                np.testing.assert_array_equal(
+                    packed[r, p, b : b + rows], dense[r, p, b : b + rows]
+                )
+                np.testing.assert_array_equal(
+                    packed[r, p, b + rows : b + B], log[r, p, b + rows : b + B]
+                )
+            else:
+                np.testing.assert_array_equal(packed[r, p], log[r, p])
+
+
+def test_packed_uniform_lockstep_block():
+    """The hottest shape: every partition active, equal bases, one shared
+    partial extent — the packed uniform fast path's single strided DMA
+    must match the packed XLA fallback byte-for-byte."""
+    rng = np.random.default_rng(11)
+    R, P, S, SB, B = 3, 16, 64, 128, 16
+    log = rng.integers(0, 256, size=(R, P, S, SB), dtype=np.uint8)
+    entries = rng.integers(0, 256, size=(P, B, SB), dtype=np.uint8)
+    base = np.full((P,), 2 * ALIGN, np.int32)
+    do_write = np.ones((R, P), bool)
+    extents = np.full((P,), ALIGN, np.int32)  # half the window
+    got = np.asarray(_append_pallas(
+        log, entries, base, do_write, extents=extents, interpret=True
+    ))
+    want = np.asarray(append_rows_xla(log, entries, base, do_write, extents))
+    np.testing.assert_array_equal(got, want)
+    # and the clipped region really was clipped: the tail rows of each
+    # window keep their prior bytes.
+    rows = _packed_rows_ref(ALIGN, B)
+    assert rows < B
+    b = 2 * ALIGN
+    np.testing.assert_array_equal(
+        got[:, :, b + rows : b + B], log[:, :, b + rows : b + B]
+    )
+
+
+def test_packed_mixed_extent_classes_demote_uniform_block():
+    """Partitions of one grid block with DIFFERING extent classes must
+    demote to the per-entry path and still match the fallback."""
+    rng = np.random.default_rng(12)
+    R, P, S, SB, B = 2, 16, 64, 128, 16
+    log = rng.integers(0, 256, size=(R, P, S, SB), dtype=np.uint8)
+    entries = rng.integers(0, 256, size=(P, B, SB), dtype=np.uint8)
+    base = np.full((P,), ALIGN, np.int32)
+    do_write = np.ones((R, P), bool)
+    extents = np.full((P,), B, np.int32)
+    extents[3] = ALIGN  # block 0 mixed classes; block 1 stays uniform
+    got = np.asarray(_append_pallas(
+        log, entries, base, do_write, extents=extents, interpret=True
+    ))
+    want = np.asarray(append_rows_xla(log, entries, base, do_write, extents))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_packed_full_extent_equals_legacy():
+    """extents == B everywhere must reproduce the legacy full-window
+    write exactly (the packed path's identity case)."""
+    rng = np.random.default_rng(13)
+    log, entries, base, do_write = rand_case(rng)
+    P, B = entries.shape[0], entries.shape[1]
+    extents = np.full((P,), B, np.int32)
+    got = np.asarray(_append_pallas(
+        log, entries, base, do_write, extents=extents, interpret=True
+    ))
+    want = np.asarray(append_rows_xla(log, entries, base, do_write))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_packed_active_set_matches_dense():
+    from ripplemq_tpu.ops.append import (
+        _append_active_pallas,
+        append_rows_active_xla,
+    )
+
+    rng = np.random.default_rng(14)
+    log, entries, entries_c, ids, base, do_write = rand_sparse_case(rng)
+    P, B = entries.shape[0], entries.shape[1]
+    extents = (rng.integers(1, B // ALIGN + 1, size=(P,)) * ALIGN).astype(
+        np.int32
+    )
+    got_xla = np.asarray(append_rows_active_xla(
+        log.copy(), entries_c, ids, base, do_write, extents
+    ))
+    got_pl = np.asarray(_append_active_pallas(
+        log.copy(), entries_c, ids, base, do_write, extents=extents,
+        interpret=True,
+    ))
+    np.testing.assert_array_equal(got_pl, got_xla)
+
+
 @pytest.mark.parametrize("spoiler", ["base", "active"])
 def test_pallas_uniform_predicate_boundaries(spoiler):
     """One partition breaking the uniform predicate (a differing base,

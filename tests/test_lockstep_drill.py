@@ -35,6 +35,12 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
+# Tier-1 runs with -m 'not slow' (ROADMAP.md): cross-process lockstep
+# drill — up to 6 min of subprocess orchestration.
+pytestmark = pytest.mark.slow
+
 
 _ORCHESTRATOR = """
 import os, signal, socket, subprocess, sys, tempfile, time

@@ -17,6 +17,9 @@ import sys
 
 import pytest
 
+# Tier-1 runs with -m 'not slow' (ROADMAP.md): Cross-process jax.distributed meshes: minutes of subprocess mesh formation.
+pytestmark = pytest.mark.slow
+
 
 def test_two_process_spmd_round_commits():
     s = socket.socket()

@@ -26,6 +26,9 @@ import time
 
 import pytest
 
+# Tier-1 runs with -m 'not slow' (ROADMAP.md): GC-churn fault soak: ~40s+ on a 1-2 core host.
+pytestmark = pytest.mark.slow
+
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
 from tests.helpers import small_cfg

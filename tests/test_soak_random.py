@@ -25,6 +25,9 @@ import time
 
 import pytest
 
+# Tier-1 runs with -m 'not slow' (ROADMAP.md): randomized multi-round fault soak: minutes per seed.
+pytestmark = pytest.mark.slow
+
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
 from tests.helpers import small_cfg
