@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""One-command chaos soak: seeded nemesis + safety checker, JSON verdict.
+
+    python profiles/chaos_soak.py --seed 7
+    python profiles/chaos_soak.py --seed 7 --phases 6 --phase-s 1.0
+    python profiles/chaos_soak.py --sweep 10           # seeds 0..9
+    python profiles/chaos_soak.py --replay trace.json  # re-apply a trace
+
+Every run prints ONE JSON document: seed, the applied fault trace, its
+sha256 digest (byte-for-byte reproducible from the seed — re-running
+`--seed N` yields the identical digest), per-phase convergence, the
+safety-invariant violations (empty = safe), and workload counts. A
+failing soak is therefore a complete bug report: ship the JSON, replay
+with `--seed N` (or `--replay trace.json` after editing the schedule
+down to a minimal reproducer).
+
+Runs on the CPU backend by default (JAX_PLATFORMS=cpu, 8 virtual
+devices) — the chaos plane attacks host-side consensus, replication,
+and retry machinery; device kernels are exercised but not the target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", type=int, default=0,
+                    help="run seeds 0..N-1 instead of --seed")
+    ap.add_argument("--phases", type=int, default=3)
+    ap.add_argument("--phase-s", type=float, default=0.6)
+    ap.add_argument("--ops-per-phase", type=int, default=2)
+    ap.add_argument("--brokers", type=int, default=3)
+    ap.add_argument("--partitions", type=int, default=2)
+    ap.add_argument("--replay", type=str, default=None,
+                    help="JSON file holding a recorded trace (or a full "
+                         "verdict) to re-apply instead of generating "
+                         "from --seed")
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the verdict JSON to this path")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from ripplemq_tpu.chaos import run_chaos
+
+    schedule = None
+    if args.replay:
+        with open(args.replay) as f:
+            doc = json.load(f)
+        trace = doc["trace"] if isinstance(doc, dict) else doc
+        n_phases = 1 + max((t.get("phase", 0) for t in trace), default=0)
+        schedule = [[] for _ in range(n_phases)]
+        for t in trace:
+            op = {k: v for k, v in t.items() if k != "phase"}
+            # restarts/heals are emitted by the nemesis itself.
+            if op.get("op") not in ("restart", "heal"):
+                schedule[t.get("phase", 0)].append(op)
+
+    seeds = list(range(args.sweep)) if args.sweep else [args.seed]
+    results = []
+    for seed in seeds:
+        v = run_chaos(
+            seed=seed,
+            n_brokers=args.brokers,
+            partitions=args.partitions,
+            phases=args.phases,
+            phase_s=args.phase_s,
+            ops_per_phase=args.ops_per_phase,
+            schedule=schedule,
+        )
+        results.append(v)
+    out = results[0] if len(results) == 1 else {
+        "sweep": len(results),
+        "safe": all(r["safe"] for r in results),
+        "unsafe_seeds": [r["seed"] for r in results if not r["safe"]],
+        "runs": results,
+    }
+    doc = json.dumps(out, indent=1)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc)
+    return 0 if (out["safe"] if "safe" in out else True) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,6 +170,12 @@ class DataPlane:
         P0 = cfg.partitions
         self.trim = np.zeros((P0,), np.int64)
         self._log_end = np.zeros((P0,), np.int64)
+        # Read-visibility horizon: rows below this are DURABLY SETTLED
+        # (device-committed + persisted + standby-acked). Device-ring
+        # reads clamp to it — device commit alone includes rounds whose
+        # replication later failed, and serving those leaks state that a
+        # controller failover rolls back (see _resolve_one).
+        self._settled_end = np.zeros((P0,), np.int64)
         # Host mirror of the committed device ring: every committed
         # round's rows pass through this host (the resolver holds them
         # to persist/replicate), so hot reads — above the trim
@@ -213,11 +219,14 @@ class DataPlane:
             self.log_index.load(store.scan_indexed(), cfg.slot_bytes,
                                 REC_APPEND)
         # Controller-failover hook: called with each round's committed
-        # records AFTER local persistence and BEFORE settling futures —
+        # records BEFORE local persistence and BEFORE settling futures —
         # the resolver blocks until the standby set acked, so a settled
         # append provably exists on every replication standby (zero
         # committed-entry loss across controller death; see
-        # broker/replication.py). Raising fails the round's futures
+        # broker/replication.py), and the local store only ever holds
+        # standby-acked records (a crash between the two steps must not
+        # leave a record recovery would serve but promotion would
+        # forget — see _resolve_one). Raising fails the round's futures
         # (FencedError ⊂ NotCommittedError → producers retry at the new
         # controller).
         self.replicate_fn = replicate_fn
@@ -422,6 +431,29 @@ class DataPlane:
             raise ValueError(f"quorum must be [P], got {quorum.shape}")
         with self._lock:
             self.quorum = quorum.copy()
+
+    def mirror_gap_slots(self) -> int:
+        """Count of slots whose host mirror is gap-disabled (resolve
+        failure; pending trim-passage heal) — taken under the plane's
+        lock (observability readers must not race the resolver's
+        heal-time dict mutation)."""
+        with self._lock:
+            return len(self._mirror_gap)
+
+    def quorum_lost(self, slot: int) -> bool:
+        """True iff partition `slot` cannot commit ANY round right now:
+        fewer replica slots alive than its quorum. Rounds for such a
+        slot are doomed before dispatch, so callers fast-fail with a
+        typed `unavailable` refusal instead of burning an RPC timeout."""
+        with self._lock:
+            return int(self.alive[slot].sum()) < int(self.quorum[slot])
+
+    def degraded_slots(self) -> list[int]:
+        """Partitions whose quorum is currently lost ([P]-masked under
+        the lock) — the `degraded` surface admin.stats advertises."""
+        with self._lock:
+            lost = self.alive.sum(axis=1) < self.quorum
+        return [int(s) for s in np.nonzero(lost)[0]]
 
     @property
     def broken_reason(self) -> Optional[str]:
@@ -642,6 +674,14 @@ class DataPlane:
                 self._reads.append((slot, offset, replica, fut))
             self._read_work.set()
             data, lens, count = fut.result()
+            # Clamp to the settled horizon: the device's commit index
+            # includes rounds whose replication may still fail — those
+            # rows are nacked and must stay invisible (see _resolve_one).
+            count = int(count)
+            with self._lock:
+                settled_room = max(0, int(self._settled_end[slot]) - offset)
+            if count > settled_room:
+                count = settled_room
             with_pos = decode_entries_with_pos(data, lens, count)
             with self._lock:
                 trim_after = int(self.trim[slot])
@@ -1441,13 +1481,12 @@ class DataPlane:
             records = []
             for k, rc in enumerate(chain):
                 records.extend(self._round_records(rc, committed[k]))
-            # Mirror committed rows into the host ring BEFORE the shadow
-            # advance admits readers to them (both are infallible numpy
-            # work; the fallible persist/replicate below must not leave
-            # the shadow behind — the device already advanced).
-            self._mirror_records(records)
             # Chain bases are exact for committed rounds (prefix
-            # property, see _drain).
+            # property, see _drain). The log-end shadow tracks what the
+            # DEVICE committed (base arithmetic for subsequent rounds
+            # must build past these rows whether or not replication
+            # settles them below) — it is NOT a read-visibility
+            # watermark; that is _settled_end.
             with self._lock:
                 for k, rc in enumerate(chain):
                     for slot in rc["appends"]:
@@ -1455,14 +1494,50 @@ class DataPlane:
                         if committed[k, slot] and n > 0:
                             adv = -(-n // ALIGN) * ALIGN
                             self._log_end[slot] = rc["bases"][slot] + adv
+            # Replicate BEFORE the local persist: the local store must
+            # only ever contain standby-acked records, or a controller
+            # crash between persist and replicate leaves a record that
+            # exists NOWHERE else — its restart-recovery then replays
+            # and serves a round that was nacked to its producer, and a
+            # (possibly late-committing) promotion forgets it again: two
+            # divergent histories observed by consumers (the seeded
+            # chaos soak caught this as a delivered-message order
+            # violation). With this order a crash before persist nacks
+            # the round everywhere EXCEPT the standby stores, whose
+            # replay is later-record-wins — the retry's re-append at the
+            # same base supersedes the orphaned copy.
+            if self.replicate_fn is not None and records:
+                self.replicate_fn(records)
+            self._persist_round(records)
+            # ---- DURABLY SETTLED from here: the round is persisted AND
+            # standby-acked. Only now may readers see its effects —
+            # mirror rows (the _cache_end advance admits cache readers),
+            # the settled-read horizon, and the consumer-offset shadow.
+            # Advancing any of these before replicate() succeeded served
+            # state that a controller failover then rolled back: the
+            # seeded chaos soak caught it as an acked-commit offset
+            # REGRESSION across a promotion (read 24, failover, read 16)
+            # — rounds that fail replication are nacked to their
+            # producers/committers and must stay invisible to reads.
+            # (Residual window: rows of a replication-FAILED round that
+            # the ring recycles within this controller's lifetime are
+            # store-served below trim — local-store consistent, and only
+            # nacked data; acked state never regresses.)
+            self._mirror_records(records)
+            with self._lock:
+                for k, rc in enumerate(chain):
+                    for slot in rc["appends"]:
+                        n = rc["counts"].get(slot, 0)
+                        if committed[k, slot] and n > 0:
+                            adv = -(-n // ALIGN) * ALIGN
+                            end = rc["bases"][slot] + adv
+                            if end > self._settled_end[slot]:
+                                self._settled_end[slot] = end
                     for slot, taken_off in rc["offsets"].items():
                         if committed[k, slot]:
                             for pend in taken_off:
                                 for cs, off in pend.payloads:
                                     self._offsets_shadow[slot, cs] = off
-            self._persist_round(records)
-            if self.replicate_fn is not None and records:
-                self.replicate_fn(records)
             # Settle in REVERSE round order: failed pendings requeue at
             # the queue FRONT, so the earliest round's retries must be
             # inserted last to land first. Pad charging belongs to the
@@ -1592,6 +1667,7 @@ class DataPlane:
         with self._lock:
             self._log_end = ends.copy()
             self._persisted = ends.copy()  # the image came FROM the store
+            self._settled_end = ends.copy()  # store records are settled
             if self._host_ring is not None:
                 # Seed the mirror from the replayed image: rows land at
                 # their ring positions during replay, so the first
@@ -1610,12 +1686,18 @@ class DataPlane:
                  "max log end %d", int((ends > 0).sum()), int(ends.max()))
 
     def _fail_round(self, ctx, exc: Exception) -> None:
-        if self.broken_reason is not None and not isinstance(
-                exc, NotCommittedError):
-            # Producers must see a RETRYABLE refusal (retry lands on the
-            # promoted controller after abdication), not an opaque
-            # internal RuntimeError from the lockstep transport.
-            exc = NotCommittedError(f"data plane broken: {exc}")
+        if not isinstance(exc, NotCommittedError):
+            if self.broken_reason is not None:
+                # Producers must see a RETRYABLE refusal (retry lands on
+                # the promoted controller after abdication), not an opaque
+                # internal RuntimeError from the lockstep transport.
+                exc = NotCommittedError(f"data plane broken: {exc}")
+            elif getattr(exc, "retryable", False):
+                # Transient engine failure that did NOT condemn the plane
+                # (e.g. a pre-broadcast lockstep send failure — the seq
+                # was restored, the next round can succeed): same typed
+                # refusal, same client retry path.
+                exc = NotCommittedError(f"transient engine failure: {exc}")
         for taken in ctx["appends"].values():
             for pend, _, _ in taken:
                 if not pend.future.done():

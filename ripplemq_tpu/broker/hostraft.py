@@ -99,6 +99,14 @@ class RaftNode:
         self.snap_state: Any = None
         self.commit_index = 0
         self.last_applied = 0
+        # Highest commit index the CURRENT cluster has advertised to us
+        # this process lifetime (unclipped — a restarted node's log may
+        # trail it). Volatile by design: `last_applied >= max_commit_seen
+        # > 0` proves the locally applied metadata includes every entry
+        # committed before (re)boot — the freshness gate a restarted
+        # broker needs before trusting recovered metadata that names it
+        # controller (see BrokerServer._metadata_current).
+        self.max_commit_seen = 0
 
         # Leader state.
         self.next_index: dict[int, int] = {}
@@ -311,6 +319,9 @@ class RaftNode:
             self._step_down(msg["term"], msg["leader"])
         self.leader_hint = msg["leader"]
         self._ticks_since_heard = 0
+        # UNCLIPPED leader commit: the freshness horizon a restarted
+        # node must apply up to before its metadata is current.
+        self.max_commit_seen = max(self.max_commit_seen, int(msg["commit"]))
 
         prev = msg["prev_index"]
         # Reject on a gap or a conflicting prev entry; leader backtracks.
@@ -354,6 +365,10 @@ class RaftNode:
         self._step_down(msg["term"], msg["leader"])
         self.leader_hint = msg["leader"]
         self._ticks_since_heard = 0
+        # A snapshot covers only committed entries: its last_index is a
+        # lower bound on the leader's commit (freshness horizon).
+        self.max_commit_seen = max(self.max_commit_seen,
+                                   int(msg["last_index"]))
         if msg["last_index"] <= self.commit_index:
             # Stale/reordered snapshot (we already committed past it):
             # installing would roll the state machine back and re-apply

@@ -14,11 +14,15 @@ REC_OFFSETS) — to a *standby set* recorded in the replicated metadata
 
 Protocol invariants:
 
-- **Settle-after-ack.** The DataPlane resolver calls `replicate()` after
-  local persistence and BEFORE settling producer futures; `replicate()`
-  blocks until every broker in the current standby set acked the round.
-  Hence every *settled* append exists on every standby — promoting any
-  set member loses no acked entry (zero committed-entry loss).
+- **Settle-after-ack.** The DataPlane resolver calls `replicate()`
+  BEFORE local persistence and BEFORE settling producer futures;
+  `replicate()` blocks until every broker in the current standby set
+  acked the round (an empty set refuses once members ever existed — no
+  durable copy, no ack). Hence every *settled* append exists on every
+  standby — promoting any set member loses no acked entry (zero
+  committed-entry loss) — and the local store only ever holds
+  standby-acked records (recovery cannot resurrect a history the
+  standbys never saw).
 - **Epoch fencing.** Every `repl.rounds` RPC carries the controller
   epoch. A standby whose replicated metadata knows a newer epoch rejects
   with `stale_epoch`; the deposed controller's rounds then fail with
@@ -170,12 +174,28 @@ class _Sender(threading.Thread):
                         FencedError("controller deposed (local metadata)")
                     )
                     break
+                # Epoch is stamped ONCE per delivery attempt from the
+                # ACTIVE view — the active() check above just passed, so
+                # this is the epoch we legitimately stream under. It
+                # must never be re-read after a deposition: a deposed
+                # sender re-stamping its stale backlog with the NEW
+                # epoch would walk it straight through the standby's
+                # fence (the seeded chaos soak caught that as an acked
+                # produce the promoted controller had never seen).
+                epoch = self._rep.epoch_fn()
+                if not self._rep.active():
+                    # Deposed between the check and the stamp: the epoch
+                    # read may be the successor's. Refuse the round.
+                    fut.set_exception(
+                        FencedError("controller deposed (local metadata)")
+                    )
+                    break
                 try:
                     resp = self._rep.client.call(
                         self._rep.addr_of(self.broker_id),
                         {
                             "type": "repl.rounds",
-                            "epoch": self._rep.epoch_fn(),
+                            "epoch": epoch,
                             "records": [
                                 [t, s, b, p] for t, s, b, p in records
                             ],
@@ -191,6 +211,8 @@ class _Sender(threading.Thread):
                 failures = 0
                 self.unreachable = False
                 if resp.get("ok"):
+                    log.debug("standby %d acked %d records at epoch %d",
+                              self.broker_id, len(records), epoch)
                     fut.set_result(True)
                     break
                 if resp.get("error") == "stale_epoch":
@@ -231,6 +253,11 @@ class RoundReplicator:
         self._senders: dict[int, _Sender] = {}
         self._joining: set[int] = set()
         self._suspects: set[int] = set()
+        # Latched once members_fn() was ever non-empty: from then on an
+        # EMPTY set refuses to settle (see replicate) instead of acking
+        # rounds with no durable copy. Genesis — before the first
+        # standby joins — keeps the bootstrap behavior.
+        self._had_members = False
         self._stopped = False
 
     # -- sender management --
@@ -292,17 +319,55 @@ class RoundReplicator:
         the whole wait (the resolver passes None — a settled round MUST
         have every member's ack; the linearizable-read barrier passes a
         bound, since an unconfirmable read should refuse, not hang)."""
+        if not self.active():
+            raise FencedError("controller deposed (local metadata)")
         targets = set(self.members_fn())
+        if targets:
+            self._had_members = True
+        elif self._had_members:
+            # The set was non-empty once and is now EMPTY: settling would
+            # ack a round with zero durable copies beyond this broker —
+            # an assertion the next promotion instantly falsifies. The
+            # seeded chaos soak caught this as an acked loss: a liveness
+            # flap pruned the set to [] while a promotion was already in
+            # flight, and the old controller settled rounds the promoted
+            # plane had never seen ("round settled ... members now []").
+            # Refusing is the graceful-degradation contract: producers
+            # get a retryable refusal until a standby rejoins (or
+            # until genesis-style no-failover deployments, which never
+            # grow a member, keep the old behavior).
+            raise ReplicationError(
+                "standby set empty (failover armed): no durable copy to "
+                "settle against"
+            )
         with self._lock:
             targets |= self._joining
         senders = {bid: self._sender(bid) for bid in targets}
         futs = {bid: s.enqueue(records) for bid, s in senders.items()}
         start = time.monotonic()
+        acked: list[int] = []
+        waived: list[int] = []
         for bid, fut in futs.items():
             suspected = False
             while True:
                 if bid not in self.members_fn():
-                    break  # joiner or freshly-removed member: no ack needed
+                    # Distinguish WHY the member left the set before
+                    # waiving its ack. A same-epoch prune (suspect
+                    # removal, committed through metadata raft) is safe:
+                    # any future promotion plans from the pruned set. But
+                    # an OP_SET_CONTROLLER apply removes the PROMOTED
+                    # broker from the standby list while deposing us —
+                    # settling without ITS ack hands an acked round to a
+                    # controller that never stored it (the seeded chaos
+                    # soak caught this as an acked-produce loss: probe
+                    # acked 3 ms after the deposition applied, absent
+                    # from the promoted plane's replay). Deposed ⇒ fence.
+                    if not self.active():
+                        raise FencedError(
+                            "controller deposed (local metadata)"
+                        )
+                    waived.append(bid)
+                    break  # joiner or same-epoch prune: no ack needed
                 if (timeout_s is not None
                         and time.monotonic() - start > timeout_s):
                     # Withdraw every still-queued entry of this timed-out
@@ -315,6 +380,7 @@ class RoundReplicator:
                     )
                 try:
                     fut.result(timeout=0.05)
+                    acked.append(bid)
                     break
                 # concurrent.futures.TimeoutError is a distinct class from
                 # the builtin before Python 3.11 — catching only the
@@ -344,7 +410,27 @@ class RoundReplicator:
                         # partitioned controller being stopped must not
                         # settle its stranded in-flight rounds.)
                         raise
+                    # Same deposition guard as the member-removed branch
+                    # above: the fence duty STOPS the replicator in the
+                    # same breath as the OP_SET_CONTROLLER apply that
+                    # shrinks the member set — "sender stopped" plus
+                    # "member left" here usually MEANS deposed, and a
+                    # waiver would settle a round the promoted
+                    # controller never stored (chaos-soak-caught acked
+                    # loss, sibling of the branch above).
+                    if not self.active():
+                        raise FencedError(
+                            "controller deposed (local metadata)"
+                        ) from None
+                    waived.append(bid)
                     break  # member left the set: ack no longer required
+
+        if records:
+            log.debug(
+                "round settled: %d records; acked by %s, waived %s, "
+                "members now %s",
+                len(records), acked, waived, sorted(self.members_fn()),
+            )
 
     # -- catch-up (controller duty worker thread) --
 

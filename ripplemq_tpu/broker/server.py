@@ -55,6 +55,7 @@ from ripplemq_tpu.broker.manager import (
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import group_key, topics_to_wire
 from ripplemq_tpu.utils.logs import get_logger
+from ripplemq_tpu.wire.retry import RetryPolicy
 from ripplemq_tpu.wire.transport import (
     InProcNetwork,
     RpcError,
@@ -64,6 +65,17 @@ from ripplemq_tpu.wire.transport import (
 )
 
 log = get_logger("broker")
+
+
+class _UpstreamRefusal(Exception):
+    """A typed refusal from the controller that must reach the client
+    VERBATIM (e.g. `unavailable:` quorum-lost degradation) — wrapping it
+    in not_committed/internal would strip the prefix the error taxonomy
+    and operator tooling key on. Carries the upstream response dict."""
+
+    def __init__(self, resp: dict) -> None:
+        super().__init__(str(resp.get("error", "")))
+        self.resp = dict(resp)
 
 
 class _BarrierGate:
@@ -133,6 +145,12 @@ class BrokerServer:
         data_dir: Optional[str] = None,
         engine_workers: Optional[list[str]] = None,
     ) -> None:
+        # FIRST: a partially-constructed broker (any raise below) must
+        # refuse teardown — harness/cluster cleanup calls stop() on
+        # whatever exists, and running it against half-constructed state
+        # turns one boot failure into a cascade (advisor round-5
+        # finding). Flipped to False as __init__'s last statement.
+        self._stopped = True
         self.broker_id = broker_id
         self.config = config
         self.info = config.broker(broker_id)
@@ -273,23 +291,17 @@ class BrokerServer:
             self.manager.attach_dataplane(dataplane)
             if dataplane.replicate_fn is None and self._round_store is not None:
                 dataplane.replicate_fn = self._make_replicator().replicate
-        elif self.manager.current_controller() == broker_id:
-            try:
-                self._boot_dataplane()
-            except Exception as e:
-                # A failed genesis boot must not kill the broker: a
-                # worker-startup race (engine workers have no cross-host
-                # ordering guarantee) is indistinguishable here from a
-                # permanent misconfiguration, so the takeover duty
-                # retries while dataplane is None — every attempt is
-                # WARN-logged and counted in admin.stats
-                # (`boot_failures`), and once standbys exist repeated
-                # failures abdicate.
-                log.warning(
-                    "broker %d: genesis data-plane boot failed "
-                    "(duty loop will retry): %s: %s",
-                    broker_id, type(e).__name__, e,
-                )
+        # No construction-time boot when this broker's (possibly
+        # RECOVERED) metadata names it controller: recovered metadata can
+        # be arbitrarily stale — a broker restarting after a controller
+        # failover would resurrect a deposed plane and serve stale reads
+        # (and, with an empty persisted standby set, even ACK produces
+        # with no fencing proof) until its raft caught up — the
+        # split-brain window the seeded chaos soak caught as acked-loss
+        # and offset-regression violations. The takeover duty boots the
+        # plane instead, gated on _metadata_current(): genesis cold
+        # start costs one metadata election (~the existing bootstrap
+        # fixpoint); restart-into-a-moved-on-cluster never boots at all.
 
         self._duty_thread = threading.Thread(
             target=self._duty_loop, daemon=True, name=f"broker-duty-{broker_id}"
@@ -305,6 +317,8 @@ class BrokerServer:
         self._engine_busy_at = 0.0  # last duty tick the plane looked busy
         # Read-index barrier (linearizable_reads; see _BarrierGate).
         self._barrier_gate = _BarrierGate(self._fire_read_barrier)
+        # Fully constructed: teardown may now run (see the top of __init__).
+        self._stopped = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -347,6 +361,17 @@ class BrokerServer:
             # attempt.
             image = None
             if self._round_store is not None:
+                # Flush barrier BEFORE the replay scan: scan() may miss
+                # (or stop torn at) a concurrently-appended tail, and a
+                # promoted standby can be booting an instant after it
+                # acked the deposed controller's LAST settled round —
+                # that acked record must be in the replayed image or the
+                # handover loses it (the seeded chaos soak caught
+                # exactly this as an acked-produce loss: ack and
+                # promotion 10 ms apart). After the local epoch bump
+                # applied, the repl.rounds fence refuses the stale
+                # stream, so nothing new lands mid-scan.
+                self._round_store.flush()
                 image = replay_records(
                     self.config.engine, self._round_store.scan()
                 )
@@ -452,8 +477,11 @@ class BrokerServer:
     def stop(self) -> None:
         # Idempotent: a killed-but-never-restarted broker is stopped
         # again by harness/cluster teardown, and the second pass must
-        # not flush the segment store the first one closed.
-        if getattr(self, "_stopped", False):
+        # not flush the segment store the first one closed. Initialized
+        # True at the TOP of __init__ (and flipped False at its end), so
+        # teardown after a partial __init__ failure is a no-op instead
+        # of a crash against half-constructed state.
+        if self._stopped:
             return
         self._stopped = True
         self._stop.set()
@@ -475,6 +503,16 @@ class BrokerServer:
     # ------------------------------------------------------------- dispatch
 
     def dispatch(self, req: dict) -> dict:
+        resp = self._dispatch(req)
+        if isinstance(resp, dict):
+            # Every response names its serving broker: clients and the
+            # chaos history checker attribute outcomes to a concrete
+            # broker when reconstructing a failure (who acked this
+            # produce, whose view served this read).
+            resp.setdefault("broker", self.broker_id)
+        return resp
+
+    def _dispatch(self, req: dict) -> dict:
         t = req.get("type", "")
         try:
             if t in RAFT_TYPES:
@@ -507,6 +545,8 @@ class BrokerServer:
             if t.startswith("engine."):
                 return self._handle_engine(t, req)
             return {"ok": False, "error": f"unknown request type {t!r}"}
+        except _UpstreamRefusal as e:
+            return dict(e.resp)
         except NotCommittedError as e:
             return {"ok": False, "error": f"not_committed: {e}"}
         except ConsumerTableFullError as e:
@@ -575,12 +615,20 @@ class BrokerServer:
                 "read_cache_hits": dp.read_cache_hits,
                 # Slots whose host mirror is gap-disabled (resolve
                 # failure; pending trim-passage heal) — a silent cache
-                # regression the operator should be able to see.
-                "mirror_gap_slots": len(dp._mirror_gap),
+                # regression the operator should be able to see. Read
+                # through the locked accessor: the resolver mutates the
+                # gap dict concurrently.
+                "mirror_gap_slots": dp.mirror_gap_slots(),
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
                 "partitions": dp.cfg.partitions,
+                # Graceful-degradation surface: partitions whose replica
+                # quorum is lost fast-fail consumes/commits with
+                # `unavailable` instead of hanging; the flag makes that
+                # state operator-visible before the first refusal.
+                "degraded_slots": dp.degraded_slots(),
             }
+            engine["degraded"] = bool(engine["degraded_slots"])
             slots = req.get("slots")
             if slots:
                 # One device fetch for ALL requested slots (a per-slot
@@ -890,12 +938,24 @@ class BrokerServer:
     def propose_cmd(self, cmd: dict, retries: int = 3) -> bool:
         """Propose a metadata command, forwarding to the metadata leader if
         this broker is not it (the reference's forwarding-with-retries,
-        PartitionManager.java:219-246)."""
-        for _ in range(retries):
+        PartitionManager.java:219-246). Retries ride the same unified
+        RetryPolicy as the clients (wire/retry.py): jittered exponential
+        backoff from the duty interval, the whole operation bounded by
+        one rpc-timeout deadline budget — a partitioned metadata leader
+        costs a bounded stall, not retries x timeout."""
+        policy = RetryPolicy(
+            max_attempts=retries,
+            base_backoff_s=self._duty_interval_s,
+            max_backoff_s=max(self._duty_interval_s, 0.5),
+            deadline_s=self.config.rpc_timeout_s * max(1, retries),
+        )
+        run = policy.begin()
+        while run.attempt():
             node = self.runner.node
             if node.role == LEADER:
                 if self.runner.propose(cmd) is not None:
                     return True
+                run.note("local propose refused (lost leadership?)")
             else:
                 hint = node.leader_hint
                 if hint is not None and hint != self.broker_id:
@@ -903,13 +963,15 @@ class BrokerServer:
                         resp = self._raft_client.call(
                             self._addr_of(hint),
                             {"type": "meta.propose", "cmd": cmd},
-                            timeout=self.config.rpc_timeout_s,
+                            timeout=run.clip(self.config.rpc_timeout_s),
                         )
                         if resp.get("ok"):
                             return True
-                    except RpcError:
-                        pass
-            time.sleep(self._duty_interval_s)
+                        run.note(str(resp.get("error", "")))
+                    except RpcError as e:
+                        run.note(str(e))
+                else:
+                    run.note("no metadata leader hint")
         return False
 
     # -- data path ---------------------------------------------------------
@@ -971,9 +1033,28 @@ class BrokerServer:
                     "committed": committed}
         return {"ok": True, "base_offset": base0, "count": committed}
 
+    def _quorum_refusal(self, slot: int) -> Optional[dict]:
+        """Graceful degradation: when the partition's replica quorum is
+        lost (mask says no round can commit), fail FAST with a typed,
+        retryable `unavailable` refusal instead of letting the request
+        hang into its RPC timeout (consume's auto-commit and offset
+        commits ride quorum rounds that are doomed before dispatch).
+        Only the controller can see the mask; non-controller leaders get
+        the same refusal from the controller's engine.* handlers."""
+        dp = self._local_engine()
+        if dp is not None and dp.quorum_lost(slot):
+            return {"ok": False,
+                    "error": f"unavailable: partition slot {slot} lost "
+                             f"its replica quorum (degraded; retry after "
+                             f"heal)"}
+        return None
+
     def _handle_consume(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
         slot, refusal = self._check_partition(key)
+        if refusal:
+            return refusal
+        refusal = self._quorum_refusal(slot)
         if refusal:
             return refusal
         cslot = self._resolve_consumer(req["consumer"])
@@ -998,6 +1079,9 @@ class BrokerServer:
     def _handle_offset_commit(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
         slot, refusal = self._check_partition(key)
+        if refusal:
+            return refusal
+        refusal = self._quorum_refusal(slot)
         if refusal:
             return refusal
         cslot = self._resolve_consumer(req["consumer"])
@@ -1060,9 +1144,20 @@ class BrokerServer:
             self._controller_addr(), req, timeout=self.config.rpc_timeout_s
         )
         if not resp.get("ok"):
-            if "not_committed" in str(resp.get("error", "")):
-                raise NotCommittedError(resp["error"])
-            raise RpcError(f"engine call failed: {resp.get('error')}")
+            err = str(resp.get("error", ""))
+            if err.startswith("unavailable:"):
+                # Typed degradation refusal (quorum lost): pass it to
+                # the client verbatim — a non-controller leader must
+                # surface the same `unavailable:` prefix the controller
+                # serves directly (_quorum_refusal).
+                raise _UpstreamRefusal(resp)
+            if "not_committed" in err or "not_controller" in err:
+                # not_controller is TRANSIENT (controller booting after
+                # restart — gated on metadata freshness — or moving):
+                # surface the same retryable refusal as an uncommitted
+                # round, not an opaque internal RpcError.
+                raise NotCommittedError(err)
+            raise RpcError(f"engine call failed: {err}")
         return resp
 
     def _engine_append(self, slot: int, messages: list[bytes]) -> Callable[[], int]:
@@ -1168,6 +1263,9 @@ class BrokerServer:
                 int(req["slot"]), int(req["cslot"]),
                 int(req.get("replica", 0)))}
         if t == "engine.offsets":
+            refusal = self._quorum_refusal(int(req["slot"]))
+            if refusal:
+                return refusal
             fut = dp.submit_offsets(
                 int(req["slot"]), [(int(s), int(o)) for s, o in req["updates"]]
             )
@@ -1299,11 +1397,34 @@ class BrokerServer:
         dp.stop()  # fails queued/in-flight rounds → producers re-route
         self._owns_dataplane = False
 
+    def _metadata_current(self) -> bool:
+        """Freshness gate for acting on metadata that names THIS broker
+        controller: True once the locally applied metadata provably
+        includes every entry the cluster committed before this process
+        (re)booted. As metadata leader, winning the election proves the
+        log is complete (Raft §5.4.1) and the election no-op barrier
+        drives commit to the log end — require it applied. As follower,
+        require application up to the highest commit the current leader
+        advertised (`max_commit_seen`, volatile per process lifetime —
+        recovered state never satisfies it by itself). Until contact
+        with the current metadata quorum, recovered controllership is
+        treated as a CLAIM, not a fact."""
+        node = self.runner.node
+        with self.runner.lock:
+            if node.role == LEADER:
+                return node.last_applied >= node.last_index()
+            return (node.leader_hint is not None
+                    and node.max_commit_seen > 0
+                    and node.last_applied >= node.max_commit_seen)
+
     def _takeover_duty(self) -> None:
-        """Promoted standby: boot the device program from the local copy
-        of the committed-round stream. Every settled round was acked by
-        every standby-set member before its producer saw success, so no
-        committed entry is lost across the handover."""
+        """Promoted standby (and genesis/restarted controller): boot the
+        device program from the local copy of the committed-round
+        stream. Every settled round was acked by every standby-set
+        member before its producer saw success, so no committed entry
+        is lost across the handover. Gated on metadata freshness: a
+        restarted broker's recovered metadata may name it controller in
+        an epoch the cluster has already left (see __init__)."""
         if self.dataplane is not None:
             return
         if self.manager.current_controller() != self.broker_id:
@@ -1315,6 +1436,8 @@ class BrokerServer:
             return
         if self._round_store is None:
             return
+        if not self._metadata_current():
+            return  # recovered claim unconfirmed; retry next duty tick
         self._boot_dataplane()
 
     def _controller_duty(self) -> None:

@@ -1,0 +1,45 @@
+"""Deterministic chaos plane: seeded nemesis + end-to-end safety checker.
+
+The reference RippleMQ delegates every failure-handling question to
+SOFAJRaft and was only ever observed under docker-compose; this
+reproduction re-implements the consensus substrate (host Raft, psum
+ballots) and therefore owes itself a systematic adversary. MegaScale
+(arXiv:2402.15627) argues fault tolerance at scale is a first-class
+subsystem; Jepsen-style testing (Elle, arXiv:2003.10554) shows HOW to
+attack one: drive a real cluster with generated faults while recording
+an operation history, then check the history against the declared
+consistency contract.
+
+The pieces (each importable on its own):
+
+- `chaos.cluster`  — the library-resident in-proc N-broker cluster
+  (tests/broker_harness re-exports it; profiles use it directly).
+- `chaos.nemesis`  — a SEEDED fault scheduler: crash/restart, symmetric
+  and one-way partitions, isolation, drop/delay/duplicate, composed
+  into phases. The schedule is a pure function of (seed, roster,
+  shape), so every run emits a byte-for-byte reproducible JSON fault
+  trace and any failure replays from `--seed`.
+- `chaos.history`  — operation-history recorder + queue-semantics
+  checker: acked-produce durability, log consistency/order, offset and
+  committed-prefix monotonicity, at-most-once redelivery, phantoms.
+- `chaos.harness`  — `run_chaos(seed, ...)`: one call that boots a
+  cluster, runs producer/consumer workloads through the REAL client
+  SDK (retry policies included), lets the nemesis attack it, heals,
+  waits for re-convergence, drains the logs, and returns a JSON-able
+  verdict.
+"""
+
+from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+from ripplemq_tpu.chaos.harness import run_chaos
+from ripplemq_tpu.chaos.history import History, check_history
+from ripplemq_tpu.chaos.nemesis import Nemesis, make_schedule
+
+__all__ = [
+    "InProcCluster",
+    "make_cluster_config",
+    "run_chaos",
+    "History",
+    "check_history",
+    "Nemesis",
+    "make_schedule",
+]

@@ -1,0 +1,162 @@
+"""In-process N-broker cluster: the single-process fake-transport
+multi-broker rig SURVEY.md §4 prescribes (the reference could only
+exercise multi-broker behavior inside docker-compose).
+
+Library-resident (moved from tests/broker_harness.py, which re-exports
+it) so the chaos plane and profiles/chaos_soak.py can build clusters
+without importing the test tree.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ripplemq_tpu.broker.server import BrokerServer
+from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.metadata.cluster_config import ClusterConfig
+from ripplemq_tpu.metadata.models import BrokerInfo, Topic
+from ripplemq_tpu.wire import InProcNetwork
+
+
+def small_engine(partitions: int, replicas: int, **kw) -> EngineConfig:
+    """Small-dimension engine for in-proc clusters (identical defaults
+    to tests/helpers.small_cfg — CPU-cheap rounds, real semantics)."""
+    base = dict(
+        partitions=partitions,
+        replicas=replicas,
+        slots=64,
+        slot_bytes=32,
+        max_batch=8,
+        read_batch=8,
+        max_consumers=8,
+        max_offset_updates=4,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def make_cluster_config(n_brokers=3, topics=None, engine=None,
+                        **kw) -> ClusterConfig:
+    topics = topics or (Topic("topic1", 2, 3), Topic("topic2", 1, 3))
+    engine = engine or small_engine(
+        partitions=sum(t.partitions for t in topics),
+        replicas=max(t.replication_factor for t in topics),
+    )
+    # Fast timings for in-proc runs; production defaults mirror the
+    # reference's constants (1 s elections, 10 s membership poll) and
+    # would slow every bootstrap and failover path by seconds.
+    kw.setdefault("election_timeout_s", 0.1)
+    kw.setdefault("metadata_election_timeout_s", 0.6)
+    kw.setdefault("membership_poll_s", 0.2)
+    return ClusterConfig(
+        brokers=tuple(
+            BrokerInfo(i, "broker", 9000 + i) for i in range(n_brokers)
+        ),
+        topics=tuple(topics),
+        engine=engine,
+        rpc_timeout_s=kw.pop("rpc_timeout_s", 5.0),
+        **kw,
+    )
+
+
+class InProcCluster:
+    def __init__(self, config: ClusterConfig | None = None, n_brokers=3,
+                 data_dir=None, broker_kwargs=None):
+        """`data_dir`: optional root for per-broker durable stores
+        (<data_dir>/broker-<id>); enables restart-with-recovery (the
+        randomized soak's kill/restart schedule). `broker_kwargs`:
+        optional {broker_id: extra BrokerServer kwargs} — e.g. the
+        lockstep drill gives the controller `engine_mode="spmd"` and
+        `engine_workers=[...]` while the standbys stay local."""
+        self.config = config or make_cluster_config(n_brokers)
+        self.net = InProcNetwork()
+        self._data_dir = data_dir
+        self._broker_kwargs = dict(broker_kwargs or {})
+        self.brokers: dict[int, BrokerServer] = {}
+        for b in self.config.brokers:
+            self.brokers[b.broker_id] = self._make(b.broker_id)
+
+    def _make(self, broker_id: int) -> BrokerServer:
+        data_dir = None
+        if self._data_dir is not None:
+            import os
+
+            data_dir = os.path.join(str(self._data_dir),
+                                    f"broker-{broker_id}")
+        return BrokerServer(
+            broker_id,
+            self.config,
+            net=self.net,
+            tick_interval_s=0.02,
+            duty_interval_s=0.05,
+            data_dir=data_dir,
+            **self._broker_kwargs.get(broker_id, {}),
+        )
+
+    def kill(self, broker_id: int) -> None:
+        """Hard-kill one broker: unreachable AND stopped (its durable
+        state, if any, survives for restart)."""
+        self.net.set_down(self.brokers[broker_id].addr)
+        self.brokers[broker_id].stop()
+
+    def restart(self, broker_id: int) -> BrokerServer:
+        """Boot a fresh process-equivalent for a killed broker (recovers
+        from its data_dir when the cluster has one)."""
+        self.net.set_up(self.brokers[broker_id].addr)
+        b = self._make(broker_id)
+        self.brokers[broker_id] = b
+        b.start()
+        return b
+
+    def start(self) -> None:
+        for b in self.brokers.values():
+            b.start()
+
+    def stop(self) -> None:
+        for b in self.brokers.values():
+            b.stop()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- convenience --
+    def client(self, name="client"):
+        return self.net.client(name)
+
+    def wait_for_leaders(self, timeout=30.0) -> None:
+        """Block until every configured partition has an advertised leader
+        on every broker's view (the bootstrap fixpoint, SURVEY.md §3.1)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self._all_leaders_known(b) for b in self.brokers.values()):
+                return
+            time.sleep(0.05)
+        states = {
+            i: [
+                (t.name, a.partition_id, a.leader)
+                for t in b.manager.get_topics()
+                for a in t.assignments
+            ]
+            for i, b in self.brokers.items()
+        }
+        raise AssertionError(f"leaders not established: {states}")
+
+    def _all_leaders_known(self, broker: BrokerServer) -> bool:
+        topics = broker.manager.get_topics()
+        if not topics or not any(t.assignments for t in topics):
+            return False
+        for t in topics:
+            for a in t.assignments:
+                if a.leader is None:
+                    return False
+        return True
+
+    def leader_broker(self, topic: str, partition: int) -> BrokerServer:
+        any_b = next(iter(self.brokers.values()))
+        leader = any_b.manager.leader_of((topic, partition))
+        assert leader is not None
+        return self.brokers[leader]

@@ -1,0 +1,311 @@
+"""run_chaos: one call = one adversarial run with a safety verdict.
+
+Boots an in-proc cluster (durable per-broker stores — an in-proc
+"crash" is stop+unreachable, and recovery replays the flushed segment
+store exactly like a process restart), drives producer/consumer
+workloads through the REAL client SDK (jittered-retry policies and
+all), lets the seeded nemesis attack between heals, then drains every
+partition's final log and checks the recorded history against the
+queue-semantics invariants (chaos/history.py).
+
+The returned verdict is JSON-able: profiles/chaos_soak.py prints it
+verbatim; tests assert on `violations == []` and trace reproducibility.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import shutil
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+from ripplemq_tpu.chaos.history import (
+    History,
+    TrackingRetryPolicy,
+    check_history,
+)
+from ripplemq_tpu.chaos.nemesis import Nemesis, trace_json
+from ripplemq_tpu.client import ConsumerClient, ProducerClient
+from ripplemq_tpu.metadata.models import Topic
+
+
+class _Workload:
+    """Producer + consumer threads hammering the cluster through the
+    client SDK for the whole run (faulted windows included)."""
+
+    def __init__(self, cluster: InProcCluster, seed: int,
+                 history: History, topic: str, partitions: int) -> None:
+        self.history = history
+        self.topic = topic
+        self.partitions = partitions
+        self._stop = threading.Event()
+        bootstrap = [b.address for b in cluster.config.brokers]
+        # Short timeouts + a deadline budget per op: a faulted window
+        # must cost bounded wall-clock, not retries x timeout.
+        self._prod_policy = TrackingRetryPolicy(
+            max_attempts=4, base_backoff_s=0.02, max_backoff_s=0.2,
+            deadline_s=3.0,
+        )
+        self.producer = ProducerClient(
+            bootstrap,
+            transport=cluster.client(f"chaos-prod-{seed}"),
+            metadata_refresh_s=0.3, rpc_timeout_s=1.0,
+            retry_policy=self._prod_policy,
+        )
+        self.consumer = ConsumerClient(
+            bootstrap, f"chaos-consumer-{seed}",
+            transport=cluster.client(f"chaos-cons-{seed}"),
+            metadata_refresh_s=0.3, rpc_timeout_s=1.0,
+            retries=3, retry_backoff_s=0.02, deadline_s=3.0,
+        )
+        self._threads = [
+            threading.Thread(target=self._produce_loop, daemon=True,
+                             name="chaos-producer"),
+            threading.Thread(target=self._consume_loop, daemon=True,
+                             name="chaos-consumer"),
+        ]
+        self._seed = seed
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        self.producer.close()
+        self.consumer.close()
+
+    def _produce_loop(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            pid = i % self.partitions
+            payload = f"w{self._seed}:{i}"
+            # Record BEFORE the call: an acked-in-flight produce whose
+            # response is lost must not read as a phantom.
+            self.history.record(op="produce", client="producer",
+                                topic=self.topic, partition=pid,
+                                payload=payload, status="unknown")
+            try:
+                self.producer.produce(self.topic, payload.encode(),
+                                      partition=pid)
+            except Exception as e:
+                self.history.record(
+                    op="produce", client="producer", topic=self.topic,
+                    partition=pid, payload=payload, status="fail",
+                    attempts=getattr(self._prod_policy.last_run,
+                                     "attempts", 1),
+                    error=f"{type(e).__name__}: {e}")
+            else:
+                self.history.record(
+                    op="produce", client="producer", topic=self.topic,
+                    partition=pid, payload=payload, status="ok",
+                    attempts=getattr(self._prod_policy.last_run,
+                                     "attempts", 1))
+            i += 1
+            time.sleep(0.01)
+
+    def _consume_loop(self) -> None:
+        i = 0
+        cid = self.consumer.consumer_id
+        while not self._stop.is_set():
+            pid = i % self.partitions
+            i += 1
+            try:
+                msgs, rpid, off, nxt = self.consumer.consume_with_position(
+                    self.topic, partition=pid
+                )
+            except Exception as e:
+                # Delivered-but-uncommitted is possible (auto-commit can
+                # fail after the read): outcome unknown, no payload info.
+                self.history.record(op="consume", client=cid,
+                                    topic=self.topic, partition=pid,
+                                    status="unknown",
+                                    error=f"{type(e).__name__}: {e}")
+            else:
+                payloads = [m.decode("utf-8", "replace") for m in msgs]
+                self.history.record(op="consume", client=cid,
+                                    topic=self.topic, partition=rpid,
+                                    status="ok", offset=off,
+                                    next_offset=nxt, payloads=payloads)
+                if payloads:
+                    # auto_commit acked next_offset (consume raises
+                    # otherwise), so the commit is part of the history.
+                    self.history.record(op="commit", client=cid,
+                                        topic=self.topic, partition=rpid,
+                                        status="ok", offset=nxt)
+            time.sleep(0.01)
+
+
+def _drain_partition(cluster: InProcCluster, topic: str, pid: int,
+                     tag: str, timeout_s: float = 15.0) -> list[str]:
+    """Read one partition's FULL committed log in order via a fresh
+    auto-commit consumer (its server-tracked offset starts at 0)."""
+    bootstrap = [b.address for b in cluster.config.brokers]
+    consumer = ConsumerClient(
+        bootstrap, f"auditor-{tag}",
+        transport=cluster.client(f"auditor-{tag}"),
+        metadata_refresh_s=0.5, rpc_timeout_s=2.0,
+        retries=5, retry_backoff_s=0.05,
+    )
+    out: list[str] = []
+    empty = 0
+    deadline = time.time() + timeout_s
+    try:
+        while empty < 3 and time.time() < deadline:
+            try:
+                batch = consumer.consume(topic, partition=pid,
+                                         max_messages=64)
+            except Exception:
+                # Post-heal leadership/metadata can still be settling;
+                # the drain just needs the eventual full prefix.
+                time.sleep(0.1)
+                continue
+            if batch:
+                empty = 0
+                out.extend(m.decode("utf-8", "replace") for m in batch)
+            else:
+                empty += 1
+                time.sleep(0.05)
+    finally:
+        consumer.close()
+    return out
+
+
+def run_chaos(
+    seed: int,
+    n_brokers: int = 3,
+    partitions: int = 2,
+    replication: int = 3,
+    phases: int = 3,
+    phase_s: float = 0.6,
+    ops_per_phase: int = 2,
+    data_dir: Optional[str] = None,
+    schedule: Optional[list[list[dict]]] = None,
+    converge_timeout_s: float = 30.0,
+    include_history: bool = False,
+) -> dict:
+    """One seeded chaos run; returns the JSON-able verdict (see module
+    docstring). Pass `schedule` (a recorded trace's fault ops grouped
+    by phase) to REPLAY instead of generating from the seed."""
+    t0 = time.time()
+    topic = "chaos"
+    config = make_cluster_config(
+        n_brokers=n_brokers,
+        topics=(Topic(topic, partitions, replication),),
+        rpc_timeout_s=3.0,
+        # The checker asserts offset monotonicity and committed-prefix
+        # consistency ACROSS controller moves; with linearizable_reads
+        # off, a deposed-but-partitioned controller may serve stale
+        # reads (the DOCUMENTED anomaly, README "deviations") and the
+        # checker would flag the contract the deployment opted out of.
+        # The chaos cluster opts IN, so every surviving violation is a
+        # real bug.
+        linearizable_reads=True,
+    )
+    tmp = None
+    if data_dir is None:
+        # Durable stores are load-bearing: an in-proc restart recovers
+        # the committed-round stream from disk, which is what makes the
+        # no-acked-loss invariant CHECKABLE under controller crashes
+        # even before a standby forms.
+        tmp = data_dir = tempfile.mkdtemp(prefix=f"chaos-{seed}-")
+    history = History()
+    verdict: dict = {"seed": seed, "phases": phases,
+                     "ops_per_phase": ops_per_phase}
+    cluster = InProcCluster(config, data_dir=data_dir)
+    try:
+        cluster.start()
+        cluster.wait_for_leaders()
+        nemesis = Nemesis(cluster, seed, phases,
+                          ops_per_phase=ops_per_phase, schedule=schedule)
+        # Wait for one replication standby before the first crash:
+        # settled appends are then provably on a promotable peer.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ctrl = next(iter(cluster.brokers.values())
+                        ).manager.current_controller()
+            if (ctrl in cluster.brokers
+                    and cluster.brokers[ctrl].manager.current_standbys()):
+                break
+            time.sleep(0.05)
+        workload = _Workload(cluster, seed, history, topic, partitions)
+        workload.start()
+        convergence = []
+        try:
+            # Clean warmup: consumer registration and the first
+            # produce/consume cycle land before the adversary wakes
+            # (faulted-window ops otherwise spend the whole phase inside
+            # registration/retry stalls and the run exercises nothing).
+            time.sleep(0.3)
+            for phase in range(len(nemesis.schedule)):
+                nemesis.run_phase(phase)
+                time.sleep(phase_s)
+                nemesis.heal_phase(phase)
+                convergence.append(nemesis.wait_converged(
+                    history=history, timeout=converge_timeout_s,
+                    probe_tag=f"p{phase}",
+                ))
+            # Clean tail: post-heal reads drain through the workload
+            # consumer too (its offsets advanced through the faults).
+            time.sleep(0.3)
+        finally:
+            workload.stop()
+        final_logs = {
+            (topic, pid): _drain_partition(cluster, topic, pid,
+                                           tag=f"{seed}-{pid}")
+            for pid in range(partitions)
+        }
+        # Suspend the clean-ack exactly-once check only when a
+        # duplication was actually DELIVERED (handler ran twice) — a
+        # scheduled dup whose charge was eaten by a concurrent
+        # block/drop never duplicated anything, and the invariant
+        # must stay armed for that run.
+        dup_faults = cluster.net.dups_applied > 0
+        violations = check_history(history.ops(), final_logs,
+                                   allow_wire_dups=dup_faults)
+        ops = history.ops()
+        verdict.update(
+            trace=nemesis.trace,
+            schedule_digest=hashlib.sha256(
+                trace_json(nemesis.trace).encode()
+            ).hexdigest(),
+            converged=all(c["converged"] for c in convergence),
+            convergence=convergence,
+            violations=violations,
+            safe=(not violations) and all(c["converged"]
+                                          for c in convergence),
+            counts={
+                "produce_ok": sum(1 for o in ops if o.get("op") == "produce"
+                                  and o.get("status") == "ok"),
+                "produce_fail": sum(1 for o in ops
+                                    if o.get("op") == "produce"
+                                    and o.get("status") == "fail"),
+                "consume_ok": sum(1 for o in ops if o.get("op") == "consume"
+                                  and o.get("status") == "ok"),
+                "consume_unknown": sum(1 for o in ops
+                                       if o.get("op") == "consume"
+                                       and o.get("status") == "unknown"),
+                "delivered": sum(len(o.get("payloads", [])) for o in ops
+                                 if o.get("op") == "consume"),
+            },
+            final_log_sizes={f"{t}[{p}]": len(v)
+                             for (t, p), v in final_logs.items()},
+            elapsed_s=round(time.time() - t0, 3),
+        )
+        if include_history or violations:
+            # A violating run's history IS the bug report — always
+            # attach it (with the final logs) when something failed.
+            verdict["history"] = ops
+            verdict["final_logs"] = {
+                f"{t}[{p}]": v for (t, p), v in final_logs.items()
+            }
+        return verdict
+    finally:
+        cluster.stop()
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)

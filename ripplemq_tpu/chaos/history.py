@@ -1,0 +1,216 @@
+"""Operation history + queue-semantics safety checker.
+
+Clients record every operation's observable outcome; after the nemesis
+heals, the checker replays the history against the final drained logs
+and reports INVARIANT VIOLATIONS — the Jepsen/Elle method
+(arXiv:2003.10554, PAPERS.md) specialized to this queue's contract:
+
+1. **No acked loss** — a produce the client saw succeed must appear in
+   the final log of its partition (settled rounds were quorum-committed
+   AND standby-acked before the ack, so a crash/partition schedule that
+   loses one is a real safety bug, not bad luck).
+2. **No phantoms** — nothing in a final log or a consume batch that no
+   producer ever sent.
+3. **At-most-once beyond the documented contract** — a CLEANLY acked
+   produce (first attempt, no client retry) appears exactly once;
+   retried/unknown-outcome produces may legitimately duplicate (the
+   produce path is at-least-once under retry — broker/server.py
+   `_handle_produce` docstring) so only clean acks are held to
+   exactly-once.
+4. **Log order consistency** — each consumer's delivered sequence per
+   partition is a subsequence of the final log (no reorder, no
+   divergent replica serving a different history), and two reads at the
+   same storage offset never disagree (committed-prefix consistency).
+5. **Offset monotonicity** — per (consumer, partition): read positions
+   and acked commits never move backward, and no read re-delivers rows
+   below an offset whose commit was already acked (at-most-once
+   delivery: the auto-commit contract, client/consumer.py docstring).
+
+Ops are plain JSON-able dicts so a failing run's history can be dumped
+next to its fault trace and replayed offline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ripplemq_tpu.wire.retry import RetryPolicy
+
+
+class History:
+    """Thread-safe append-only operation log (workload threads record
+    concurrently with nemesis phases)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ops: list[dict] = []
+
+    def record(self, **op) -> None:
+        with self._lock:
+            op["i"] = len(self._ops)  # stable total order of recording
+            op["t"] = round(time.time(), 4)  # forensics: align with logs
+            self._ops.append(op)
+
+    def ops(self) -> list[dict]:
+        with self._lock:
+            return list(self._ops)
+
+
+class TrackingRetryPolicy(RetryPolicy):
+    """RetryPolicy that remembers the last operation's RetryRun, so a
+    single-threaded workload can ask "did that produce retry?" — the
+    fact that decides whether a duplicate in the final log is a
+    contract violation (clean ack) or legitimate at-least-once fallout
+    (retried ack)."""
+
+    def __init__(self, *a, **kw) -> None:
+        super().__init__(*a, **kw)
+        self.last_run = None
+
+    def begin(self):
+        run = super().begin()
+        self.last_run = run
+        return run
+
+
+# ----------------------------------------------------------------- checker
+
+def _subsequence_gap(needle: list[str], hay: list[str]) -> Optional[str]:
+    """First element of `needle` that cannot be matched while scanning
+    `hay` in order (None = needle is a subsequence of hay)."""
+    it = iter(hay)
+    for x in needle:
+        for y in it:
+            if y == x:
+                break
+        else:
+            return x
+    return None
+
+
+def check_history(ops: list[dict],
+                  final_logs: dict[tuple[str, int], list[str]],
+                  allow_wire_dups: bool = False) -> list[str]:
+    """Return the list of invariant violations (empty = safe).
+
+    `ops`: History.ops(). `final_logs`: {(topic, partition): [payload,
+    ...]} — every partition's full committed log drained AFTER heal.
+    `allow_wire_dups`: the fault schedule contained RPC duplication
+    (`dup_next`) — a duplicated produce/forward RPC legitimately
+    appends twice (the wire is at-least-once, there is no idempotent
+    producer id), so the clean-ack exactly-once check is suspended.
+    """
+    violations: list[str] = []
+    produced: dict[str, dict] = {}
+    for op in ops:
+        if op.get("op") == "produce":
+            produced[op["payload"]] = op
+
+    # 1 + 3: acked durability and clean-ack exactly-once.
+    log_counts: dict[tuple[str, int], dict[str, int]] = {}
+    for part, log in final_logs.items():
+        counts: dict[str, int] = {}
+        for p in log:
+            counts[p] = counts.get(p, 0) + 1
+        log_counts[part] = counts
+    for payload, op in produced.items():
+        part = (op["topic"], op["partition"])
+        n = log_counts.get(part, {}).get(payload, 0)
+        if op["status"] == "ok" and n == 0:
+            violations.append(
+                f"acked loss: produce {payload!r} -> {part} acked "
+                f"(attempts={op.get('attempts', 1)}) but absent from the "
+                f"final log"
+            )
+        if (op["status"] == "ok" and op.get("attempts", 1) == 1 and n > 1
+                and not allow_wire_dups):
+            violations.append(
+                f"duplicate beyond contract: clean first-attempt ack of "
+                f"{payload!r} appears {n}x in {part}"
+            )
+
+    # 2: phantoms — in the final logs…
+    for part, log in final_logs.items():
+        for payload in log:
+            if payload not in produced:
+                violations.append(
+                    f"phantom: {payload!r} in final log of {part} was "
+                    f"never produced"
+                )
+    # …and in consume batches.
+    for op in ops:
+        if op.get("op") != "consume" or op.get("status") != "ok":
+            continue
+        for payload in op.get("payloads", []):
+            if payload not in produced:
+                violations.append(
+                    f"phantom delivery: consumer {op['client']} got "
+                    f"{payload!r} never produced"
+                )
+
+    # 4: per-consumer delivered order is a subsequence of the final log;
+    # same-offset reads agree (committed-prefix consistency).
+    streams: dict[tuple[str, str, int], list[str]] = {}
+    by_offset: dict[tuple[str, int, int], list[str]] = {}
+    for op in ops:
+        if op.get("op") != "consume" or op.get("status") != "ok":
+            continue
+        key = (op["client"], op["topic"], op["partition"])
+        streams.setdefault(key, []).extend(op.get("payloads", []))
+        if op.get("payloads"):
+            okey = (op["topic"], op["partition"], op["offset"])
+            prev = by_offset.get(okey)
+            cur = list(op["payloads"])
+            if prev is not None:
+                short, long_ = sorted((prev, cur), key=len)
+                if long_[: len(short)] != short:
+                    violations.append(
+                        f"divergent reads at {okey}: {prev!r} vs {cur!r}"
+                    )
+                by_offset[okey] = long_
+            else:
+                by_offset[okey] = cur
+    for (client, topic, pid), seq in streams.items():
+        log = final_logs.get((topic, pid), [])
+        gap = _subsequence_gap(seq, log)
+        if gap is not None:
+            violations.append(
+                f"order violation: consumer {client} stream for "
+                f"({topic}, {pid}) is not a subsequence of the final log "
+                f"(first mismatch at {gap!r})"
+            )
+
+    # 5: offset monotonicity + no redelivery below an acked commit.
+    pos: dict[tuple[str, str, int], int] = {}
+    committed: dict[tuple[str, str, int], int] = {}
+    for op in ops:
+        key = (op.get("client"), op.get("topic"), op.get("partition"))
+        if op.get("op") == "consume" and op.get("status") == "ok":
+            off, nxt = int(op["offset"]), int(op["next_offset"])
+            if nxt < off:
+                violations.append(
+                    f"offset regression within read: {op}"
+                )
+            if off < pos.get(key, 0):
+                violations.append(
+                    f"offset went backward for {key}: read at {off} after "
+                    f"position {pos[key]}"
+                )
+            if op.get("payloads") and off < committed.get(key, 0):
+                violations.append(
+                    f"redelivery below acked commit for {key}: read at "
+                    f"{off} < committed {committed[key]} (at-most-once "
+                    f"contract)"
+                )
+            pos[key] = max(pos.get(key, 0), nxt if op.get("payloads") else off)
+        elif op.get("op") == "commit" and op.get("status") == "ok":
+            off = int(op["offset"])
+            if off < committed.get(key, 0):
+                violations.append(
+                    f"acked commit went backward for {key}: {off} < "
+                    f"{committed[key]}"
+                )
+            committed[key] = max(committed.get(key, 0), off)
+    return violations

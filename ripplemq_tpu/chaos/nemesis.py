@@ -1,0 +1,260 @@
+"""Seeded nemesis: reproducible fault schedules against an in-proc cluster.
+
+The schedule is a PURE FUNCTION of (seed, broker roster, shape knobs) —
+`make_schedule` consults nothing dynamic (no wall clock, no cluster
+state), so two runs with the same seed apply byte-for-byte identical
+fault traces even though the cluster's reactions (elections, promotions,
+retries) differ in timing. That is the property that makes a chaos
+failure a BUG REPORT: re-run `profiles/chaos_soak.py --seed N` and the
+same adversary returns.
+
+Fault vocabulary (composing the InProcNetwork hooks, wire/transport.py):
+
+  crash b        kill broker b (network-down + stopped; durable state kept)
+  restart b      boot a fresh process-equivalent for a crashed broker
+  isolate b      symmetric partition of b from every other broker
+  partition a b  symmetric link partition between two brokers
+  oneway a b     asymmetric partition: only a→b traffic vanishes
+  drop a b n     drop the next n requests on a link
+  delay a b n s  stall the next n requests on a link by s seconds
+  dup a b n      deliver the next n requests on a link twice
+  kill_worker w  lockstep engine-worker kill (only when the cluster
+                 runs a lockstep mesh; exercises abdication/promotion)
+
+Crash scheduling keeps a metadata majority alive (at most (n-1)//2
+concurrently crashed) — the checker tests safety under faults the
+system CLAIMS to survive; losing quorum entirely is the degraded-mode
+path (`unavailable` refusals), exercised separately.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+from typing import Optional
+
+# Weighted op pool: link faults are cheap and frequent, crashes rarer
+# (each costs a recovery), duplication/delay spice the RPC layer.
+_OP_WEIGHTS = (
+    ("crash", 3),
+    ("isolate", 2),
+    ("partition", 3),
+    ("oneway", 2),
+    ("drop", 3),
+    ("delay", 2),
+    ("dup", 2),
+)
+
+
+def make_schedule(
+    seed: int,
+    broker_ids: list[int],
+    phases: int,
+    ops_per_phase: int = 2,
+    lockstep_workers: tuple[str, ...] = (),
+) -> list[list[dict]]:
+    """Deterministic [phases][ops] fault schedule. Each phase ends with
+    an implicit heal (the nemesis records it in the trace), so phases
+    start from a clean network with every broker up."""
+    rng = random.Random(seed)
+    pool = list(_OP_WEIGHTS)
+    if lockstep_workers:
+        pool.append(("kill_worker", 1))
+    names = [n for n, w in pool for _ in range(w)]
+    max_crashed = (len(broker_ids) - 1) // 2
+    schedule: list[list[dict]] = []
+    for phase in range(phases):
+        ops: list[dict] = []
+        crashed: set[int] = set()
+        for _ in range(ops_per_phase):
+            name = rng.choice(names)
+            if name == "crash" and len(crashed) >= max_crashed:
+                name = "partition"  # keep the metadata majority alive
+            if name == "crash":
+                b = rng.choice(sorted(set(broker_ids) - crashed))
+                crashed.add(b)
+                ops.append({"op": "crash", "broker": b})
+            elif name == "isolate":
+                b = rng.choice(broker_ids)
+                ops.append({"op": "isolate", "broker": b})
+            elif name in ("partition", "oneway"):
+                a, b = rng.sample(broker_ids, 2)
+                ops.append({"op": name, "a": a, "b": b})
+            elif name in ("drop", "dup"):
+                a, b = rng.sample(broker_ids, 2)
+                ops.append({"op": name, "a": a, "b": b,
+                            "n": rng.randint(1, 5)})
+            elif name == "delay":
+                a, b = rng.sample(broker_ids, 2)
+                ops.append({"op": "delay", "a": a, "b": b,
+                            "n": rng.randint(1, 4),
+                            "delay_ms": rng.choice([10, 25, 50])})
+            elif name == "kill_worker":
+                ops.append({"op": "kill_worker",
+                            "worker": rng.choice(list(lockstep_workers))})
+        schedule.append(ops)
+    return schedule
+
+
+def expected_trace(schedule: list[list[dict]]) -> list[dict]:
+    """The exact trace a Nemesis run of `schedule` emits — a pure
+    function (fault ops in order, then the phase's crash restarts in
+    sorted order, then the heal marker). `trace_json(expected_trace(s))
+    == trace_json(nemesis.trace)` is the byte-for-byte reproducibility
+    contract tests assert."""
+    trace: list[dict] = []
+    for phase, ops in enumerate(schedule):
+        crashed: set[int] = set()
+        for op in ops:
+            trace.append({"phase": phase, **op})
+            if op["op"] == "crash":
+                crashed.add(op["broker"])
+        for b in sorted(crashed):
+            trace.append({"phase": phase, "op": "restart", "broker": b})
+        trace.append({"phase": phase, "op": "heal"})
+    return trace
+
+
+def trace_json(trace: list[dict]) -> str:
+    """Canonical byte-for-byte trace encoding (sorted keys, no spaces):
+    equal seeds ⇒ equal strings ⇒ equal sha256 digests."""
+    return json.dumps(trace, sort_keys=True, separators=(",", ":"))
+
+
+class Nemesis:
+    """Applies a schedule to a live InProcCluster and records the trace.
+
+    `schedule` overrides generation — pass a previously recorded trace's
+    ops to REPLAY a failure (profiles/chaos_soak.py --replay)."""
+
+    def __init__(self, cluster, seed: int, phases: int,
+                 ops_per_phase: int = 2,
+                 lockstep_workers: tuple[str, ...] = (),
+                 schedule: Optional[list[list[dict]]] = None) -> None:
+        self.cluster = cluster
+        self.seed = seed
+        self.lockstep_workers = tuple(lockstep_workers)
+        self.schedule = schedule if schedule is not None else make_schedule(
+            seed, sorted(cluster.brokers), phases,
+            ops_per_phase=ops_per_phase,
+            lockstep_workers=self.lockstep_workers,
+        )
+        self.trace: list[dict] = []
+        self._crashed: set[int] = set()
+
+    # ------------------------------------------------------------- applying
+
+    def _addr(self, broker_id: int) -> str:
+        return self.cluster.config.broker(broker_id).address
+
+    def run_phase(self, phase: int) -> None:
+        for op in self.schedule[phase]:
+            self._apply(dict(op))
+            self.trace.append({"phase": phase, **op})
+
+    def _apply(self, op: dict) -> None:
+        net = self.cluster.net
+        kind = op["op"]
+        if kind == "crash":
+            b = op["broker"]
+            if b not in self._crashed:
+                self._crashed.add(b)
+                self.cluster.kill(b)
+        elif kind == "restart":
+            b = op["broker"]
+            if b in self._crashed:
+                self._crashed.discard(b)
+                self.cluster.restart(b)
+        elif kind == "isolate":
+            me = self._addr(op["broker"])
+            for other in self.cluster.brokers:
+                if other != op["broker"]:
+                    net.block(me, self._addr(other))
+        elif kind == "partition":
+            net.block(self._addr(op["a"]), self._addr(op["b"]))
+        elif kind == "oneway":
+            net.block_oneway(self._addr(op["a"]), self._addr(op["b"]))
+        elif kind == "drop":
+            net.drop_next(self._addr(op["a"]), self._addr(op["b"]), op["n"])
+        elif kind == "dup":
+            net.dup_next(self._addr(op["a"]), self._addr(op["b"]), op["n"])
+        elif kind == "delay":
+            net.delay_next(self._addr(op["a"]), self._addr(op["b"]),
+                           op["n"], op["delay_ms"] / 1000.0)
+        elif kind == "kill_worker":
+            net.set_down(op["worker"])
+        else:
+            raise ValueError(f"unknown nemesis op {kind!r}")
+
+    def heal_phase(self, phase: int) -> None:
+        """End-of-phase heal: clear every network fault, restart every
+        crashed broker (recorded — the heal is part of the trace)."""
+        self.cluster.net.heal()
+        for b in sorted(self._crashed):
+            self.cluster.restart(b)
+            self.trace.append({"phase": phase, "op": "restart", "broker": b})
+        self._crashed.clear()
+        for w in self.lockstep_workers:
+            self.cluster.net.set_up(w)
+        self.trace.append({"phase": phase, "op": "heal"})
+
+    # ---------------------------------------------------------- convergence
+
+    def wait_converged(self, history=None, timeout: float = 30.0,
+                       probe_tag: str = "probe") -> dict:
+        """Post-heal re-convergence: every partition has an elected
+        leader that ACCEPTS a probe produce, and no partition reports a
+        lost quorum (`degraded` drained). Probe payloads are recorded
+        into `history` (they are real acked produces — the checker
+        holds them to the same no-loss contract). Returns
+        {"converged": bool, "detail": ...}."""
+        deadline = time.time() + timeout
+        pending = [
+            (t.name, pid)
+            for t in self.cluster.config.topics
+            for pid in range(t.partitions)
+        ]
+        client = self.cluster.client(f"nemesis-{probe_tag}")
+        probe_i = 0
+        while pending and time.time() < deadline:
+            topic, pid = pending[0]
+            any_b = next(
+                b for i, b in self.cluster.brokers.items()
+                if i not in self._crashed
+            )
+            leader = any_b.manager.leader_of((topic, pid))
+            if leader is None or leader in self._crashed:
+                time.sleep(0.05)
+                continue
+            payload = f"{probe_tag}:{self.seed}:{topic}:{pid}:{probe_i}"
+            probe_i += 1
+            # Record BEFORE the call: a probe whose response is lost can
+            # still have committed, and an unrecorded committed payload
+            # would read as a phantom. "unknown" → allowed but not
+            # required in the final log; upgraded to "ok" on ack.
+            if history is not None:
+                history.record(op="produce", client=f"nemesis-{probe_tag}",
+                               topic=topic, partition=pid,
+                               payload=payload, status="unknown", attempts=1)
+            try:
+                resp = client.call(
+                    self.cluster.brokers[leader].addr,
+                    {"type": "produce", "topic": topic, "partition": pid,
+                     "messages": [payload.encode()]},
+                    timeout=5.0,
+                )
+            except Exception:
+                time.sleep(0.05)
+                continue
+            if resp.get("ok"):
+                if history is not None:
+                    history.record(op="produce", client=f"nemesis-{probe_tag}",
+                                   topic=topic, partition=pid,
+                                   payload=payload, status="ok", attempts=1,
+                                   broker=resp.get("broker"))
+                pending.pop(0)
+            else:
+                time.sleep(0.05)
+        return {"converged": not pending,
+                "detail": {"unconverged_partitions": pending}}

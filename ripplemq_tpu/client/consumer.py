@@ -11,11 +11,11 @@ at-least-once: process, then call commit() yourself.
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
 from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
+from ripplemq_tpu.wire.retry import RetryPolicy, fatal_response_error
 from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
 
 DEFAULT_MAX_MESSAGES = 10  # ConsumerClientImpl.java:21
@@ -38,6 +38,8 @@ class ConsumerClient:
         rpc_timeout_s: float = 5.0,
         retries: int = 3,
         retry_backoff_s: float = 0.2,
+        deadline_s: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -46,8 +48,13 @@ class ConsumerClient:
         self.auto_commit = auto_commit
         self.max_messages = max_messages
         self._timeout = rpc_timeout_s
-        self._retries = retries
-        self._backoff = retry_backoff_s
+        # Unified retry discipline (wire/retry.py): jittered exponential
+        # backoff, optional per-operation deadline budget.
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=retries,
+            base_backoff_s=retry_backoff_s,
+            deadline_s=deadline_s,
+        )
         self._meta = MetadataManager(
             self._transport,
             bootstrap,
@@ -79,30 +86,28 @@ class ConsumerClient:
         STORAGE offsets (the broker pads replication rounds for the TPU's
         alignment), so `offset + len(messages)` is NOT a valid position."""
         limit = self.max_messages if max_messages is None else max_messages
-        last_err: Optional[str] = None
-        for attempt in range(self._retries):
+        run = self._retry.begin()
+        while run.attempt():
             t = self._meta.topic(topic)
             if t is None:
-                last_err = f"unknown topic {topic!r}"
+                run.note(f"unknown topic {topic!r}")
                 self._refresh_quietly()
-                time.sleep(self._backoff)
                 continue
             pid = self._selector.select(t) if partition is None else partition
             addr = self._meta.leader_addr(topic, pid)
             if addr is None:
-                last_err = f"no leader known for {topic}[{pid}]"
+                run.note(f"no leader known for {topic}[{pid}]")
                 self._refresh_quietly()
-                time.sleep(self._backoff)
                 continue
             try:
                 resp = self._transport.call(
                     addr,
                     {"type": "consume", "topic": topic, "partition": pid,
                      "consumer": self.consumer_id, "max_messages": limit},
-                    timeout=self._timeout,
+                    timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
-                last_err = str(e)
+                run.note(str(e))
                 self._refresh_quietly()
                 continue
             if resp.get("ok"):
@@ -113,25 +118,23 @@ class ConsumerClient:
                     self.commit(topic, pid, next_offset)
                 return msgs, pid, offset, next_offset
             err = str(resp.get("error", ""))
-            last_err = err
+            run.note(err)
             if err == "not_leader":
                 self._refresh_quietly()
                 continue
-            if "unknown_partition" in err:
+            if fatal_response_error(err):
                 raise ConsumeError(err)
-            time.sleep(self._backoff)
-        raise ConsumeError(f"consume from {topic} failed: {last_err}")
+        raise ConsumeError(f"consume from {topic} failed: {run.summary()}")
 
     def commit(self, topic: str, partition: int, offset: int) -> None:
         """Commit an absolute offset (replicated through the partition's
         quorum round, like every offset update)."""
-        last_err: Optional[str] = None
-        for attempt in range(self._retries):
+        run = self._retry.begin()
+        while run.attempt():
             addr = self._meta.leader_addr(topic, partition)
             if addr is None:
-                last_err = f"no leader known for {topic}[{partition}]"
+                run.note(f"no leader known for {topic}[{partition}]")
                 self._refresh_quietly()
-                time.sleep(self._backoff)
                 continue
             try:
                 resp = self._transport.call(
@@ -139,21 +142,24 @@ class ConsumerClient:
                     {"type": "offset.commit", "topic": topic,
                      "partition": partition, "consumer": self.consumer_id,
                      "offset": int(offset)},
-                    timeout=self._timeout,
+                    timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
-                last_err = str(e)
+                run.note(str(e))
                 self._refresh_quietly()
                 continue
             if resp.get("ok"):
                 return
-            last_err = str(resp.get("error", ""))
-            if last_err == "not_leader":
+            err = str(resp.get("error", ""))
+            run.note(err)
+            if err == "not_leader":
                 self._refresh_quietly()
                 continue
-            time.sleep(self._backoff)
+            if fatal_response_error(err):
+                raise ConsumeError(err)
         raise ConsumeError(
-            f"offset commit {topic}[{partition}]={offset} failed: {last_err}"
+            f"offset commit {topic}[{partition}]={offset} failed: "
+            f"{run.summary()}"
         )
 
     def close(self) -> None:

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import random
 import threading
-import time
 from typing import Optional
 
 from ripplemq_tpu.metadata.models import BrokerInfo, Topic, topics_from_wire
+from ripplemq_tpu.wire.retry import RetryPolicy
 from ripplemq_tpu.wire.transport import RpcError, Transport
 
 
@@ -35,15 +35,24 @@ class MetadataManager:
         retry_backoff_s: float = 1.0,
         rpc_timeout_s: float = 3.0,
         seed: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> None:
         if not bootstrap:
             raise ValueError("need at least one bootstrap address")
         self._transport = transport
         self._bootstrap = list(bootstrap)
-        self._retries = fetch_retries
-        self._backoff = retry_backoff_s
-        self._timeout = rpc_timeout_s
         self._rng = random.Random(seed)
+        self._timeout = rpc_timeout_s
+        # Unified retry discipline (wire/retry.py). The reference retried
+        # on a fixed 1 s sleep (MetadataClient.java:34-61); this jitters
+        # and backs off exponentially under an optional deadline budget.
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=fetch_retries,
+            base_backoff_s=retry_backoff_s,
+            deadline_s=deadline_s,
+            rng=self._rng,
+        )
         self._lock = threading.Lock()
         self._topics: dict[str, Topic] = {}
         self._brokers: dict[int, BrokerInfo] = {}
@@ -82,15 +91,16 @@ class MetadataManager:
         of the bootstrap list (random start, no repeats until every
         broker was tried) — a deliberate strict improvement: one live
         bootstrap broker guarantees progress when retries >= brokers."""
-        last_err: Optional[Exception] = None
         order: list[str] = []
-        for attempt in range(self._retries):
+        run = self._retry.begin()
+        while run.attempt():
             if not order:
                 order = self._rng.sample(self._bootstrap, len(self._bootstrap))
             addr = order.pop(0)
             try:
                 resp = self._transport.call(
-                    addr, {"type": "meta.topics"}, timeout=self._timeout
+                    addr, {"type": "meta.topics"},
+                    timeout=run.clip(self._timeout),
                 )
                 if not resp.get("ok"):
                     raise MetadataError(f"{addr}: {resp.get('error')}")
@@ -102,10 +112,8 @@ class MetadataManager:
                         self._brokers = {b.broker_id: b for b in brokers}
                 return
             except (RpcError, MetadataError, KeyError, ValueError) as e:
-                last_err = e
-                if attempt + 1 < self._retries:
-                    time.sleep(self._backoff)
-        raise MetadataError(f"metadata fetch failed: {last_err}")
+                run.note(f"{type(e).__name__}: {e}")
+        raise MetadataError(f"metadata fetch failed: {run.summary()}")
 
     # ------------------------------------------------------------- queries
 

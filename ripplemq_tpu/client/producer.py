@@ -9,11 +9,11 @@ honest address resolution (see package docstring).
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
 from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
+from ripplemq_tpu.wire.retry import RetryPolicy, fatal_response_error
 from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
 
 
@@ -31,13 +31,22 @@ class ProducerClient:
         rpc_timeout_s: float = 5.0,
         retries: int = 3,
         retry_backoff_s: float = 0.2,
+        deadline_s: Optional[float] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
         self._selector = selector or RoundRobinSelector()
         self._timeout = rpc_timeout_s
-        self._retries = retries
-        self._backoff = retry_backoff_s
+        # One retry discipline for every operation (wire/retry.py):
+        # jittered exponential backoff under an optional per-call
+        # deadline budget. `retries`/`retry_backoff_s` stay as the
+        # simple knobs; pass `retry_policy` to control everything.
+        self._retry = retry_policy or RetryPolicy(
+            max_attempts=retries,
+            base_backoff_s=retry_backoff_s,
+            deadline_s=deadline_s,
+        )
         self._meta = MetadataManager(
             self._transport,
             bootstrap,
@@ -61,45 +70,42 @@ class ProducerClient:
         PartitionClient.java:39)."""
         if not messages:
             raise ValueError("empty batch")
-        last_err: Optional[str] = None
-        for attempt in range(self._retries):
+        run = self._retry.begin()
+        while run.attempt():
             t = self._meta.topic(topic)
             if t is None:
-                last_err = f"unknown topic {topic!r}"
+                run.note(f"unknown topic {topic!r}")
                 self._refresh_quietly()
-                time.sleep(self._backoff)
                 continue
             pid = self._selector.select(t) if partition is None else partition
             addr = self._meta.leader_addr(topic, pid)
             if addr is None:
-                last_err = f"no leader known for {topic}[{pid}]"
+                run.note(f"no leader known for {topic}[{pid}]")
                 self._refresh_quietly()
-                time.sleep(self._backoff)
                 continue
             try:
                 resp = self._transport.call(
                     addr,
                     {"type": "produce", "topic": topic, "partition": pid,
                      "messages": list(messages)},
-                    timeout=self._timeout,
+                    timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
-                last_err = str(e)
+                run.note(str(e))
                 self._refresh_quietly()
                 continue
             if resp.get("ok"):
                 return int(resp["base_offset"])
             err = str(resp.get("error", ""))
-            last_err = err
+            run.note(err)
             if err == "not_leader":
                 # Follow the hint next attempt via a metadata refresh; the
                 # hint's addr is also directly usable when present.
                 self._refresh_quietly()
                 continue
-            if "unknown_partition" in err or "bad_request" in err:
+            if fatal_response_error(err):
                 raise ProduceError(err)  # terminal
-            time.sleep(self._backoff)
-        raise ProduceError(f"produce to {topic} failed: {last_err}")
+        raise ProduceError(f"produce to {topic} failed: {run.summary()}")
 
     def produce_batch_async(self, topic: str, messages: list[bytes],
                             partition: Optional[int] = None):

@@ -42,6 +42,17 @@ log = get_logger("lockstep")
 LOCKSTEP_TYPE = "engine.lockstep"
 
 
+class LockstepSendError(RuntimeError):
+    """A lockstep broadcast failed BEFORE any worker received the call
+    (and before the local launch): the sequence was restored, no process
+    diverged, and the plane remains fully usable — the failed round is
+    simply retried. `retryable` is the marker DataPlane._fail_round maps
+    to a NotCommittedError so producers see an ordinary retryable
+    refusal instead of a transport stack trace."""
+
+    retryable = True
+
+
 # --------------------------------------------------------- wire marshalling
 
 def enc_value(v) -> Any:
@@ -122,8 +133,28 @@ class LockstepController:
             "method": method,
             "args": [enc_value(a) for a in args],
         }
-        return [(addr, self._client.call_async(addr, dict(req)))
-                for addr in self._workers]
+        futs = []
+        for addr in self._workers:
+            try:
+                futs.append((addr, self._client.call_async(addr, dict(req))))
+            except Exception as e:
+                if not futs:
+                    # Nothing was dispatched: no worker ever saw this
+                    # sequence number, so restoring it keeps the stream
+                    # replayable — the failure is TRANSIENT (a dropped
+                    # connection the next call re-establishes), not a
+                    # lockstep break. Graceful degradation: the round
+                    # fails retryably instead of condemning the plane.
+                    self._seq -= 1
+                    raise LockstepSendError(
+                        f"lockstep send to {addr} failed before any "
+                        f"dispatch: {type(e).__name__}: {e}"
+                    ) from e
+                # Partial dispatch: earlier workers WILL replay this seq,
+                # later ones never got it — the mesh is out of lockstep
+                # for good (the _call except path marks broken).
+                raise
+        return futs
 
     def _check(self, futs) -> None:
         for addr, fut in futs:
@@ -149,9 +180,15 @@ class LockstepController:
             with self._lock:
                 futs = self._send(method, args)
                 result = local_fn()
+        except LockstepSendError:
+            # Pre-broadcast failure: _send restored the sequence and no
+            # process (worker OR local) ran anything — the plane stays
+            # healthy and the NEXT call may succeed. Do not set broken.
+            raise
         except Exception as e:
-            # Broadcast (or local launch) failed before completing: the
-            # call stream is no longer replayable in order.
+            # Broadcast (or local launch) failed after the stream became
+            # non-replayable (some worker holds a seq the others never
+            # saw, or the local copy diverged): permanently broken.
             self.broken = f"{type(e).__name__}: {e}"
             raise
         try:

@@ -94,11 +94,18 @@ def encode_segment(store_dir: str, seg_name: str, **kw) -> list[str]:
             zlib.crc32(payload) & 0xFFFFFFFF,
         )
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(header + payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(header + payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except FileNotFoundError:
+            # The rs/ directory vanished under us (disaster-recovery
+            # teardown racing a still-draining encode worker). Shards
+            # are DERIVED data: skip — the next protect pass re-encodes
+            # from the sealed segment instead of crashing the worker.
+            return []
     return paths
 
 

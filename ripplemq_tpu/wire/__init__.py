@@ -19,6 +19,11 @@ Two interchangeable transports:
 """
 
 from ripplemq_tpu.wire.codec import decode, encode, read_frame, write_frame
+from ripplemq_tpu.wire.retry import (
+    DeadlineExceeded,
+    RetryPolicy,
+    fatal_response_error,
+)
 from ripplemq_tpu.wire.transport import (
     InProcNetwork,
     RpcError,
@@ -29,6 +34,9 @@ from ripplemq_tpu.wire.transport import (
 )
 
 __all__ = [
+    "DeadlineExceeded",
+    "RetryPolicy",
+    "fatal_response_error",
     "decode",
     "encode",
     "read_frame",

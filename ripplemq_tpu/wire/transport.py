@@ -24,6 +24,7 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+import time
 # On Python < 3.11 concurrent.futures.TimeoutError is NOT the builtin
 # TimeoutError, so Future.result timeouts must be caught as both.
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -65,8 +66,18 @@ class InProcNetwork:
       - `block(a, b)` / `unblock(a, b)`: symmetric link partition between
         two endpoint addresses — calls raise RpcTimeout (a partition looks
         like silence, not a refusal).
+      - `block_oneway(src, dst)` / `unblock_oneway`: ASYMMETRIC partition
+        — only src→dst requests vanish; dst can still reach src. The
+        classic half-open link that symmetric partitions cannot express
+        (a leader that can send heartbeats but never hear acks).
       - `drop_next(src, dst, n)`: drop the next n requests on a link —
         exercises retry paths deterministically.
+      - `dup_next(src, dst, n)`: deliver the next n requests on a link
+        TWICE (handler runs twice; the first response is discarded) —
+        exercises handler idempotence under at-least-once delivery.
+      - `delay_next(src, dst, n, delay_s)`: stall the next n requests by
+        `delay_s` on the caller's thread before the handler runs — a slow
+        link that reorders traffic relative to other links.
 
     Calls run the handler synchronously on the caller's thread: no real
     concurrency is introduced by the network itself, so test interleavings
@@ -77,9 +88,16 @@ class InProcNetwork:
         self._handlers: dict[str, Handler] = {}
         self._down: set[str] = set()
         self._blocked: set[frozenset[str]] = set()
+        self._blocked_oneway: set[tuple[str, str]] = set()
         self._drops: dict[tuple[str, str], int] = {}
+        self._dups: dict[tuple[str, str], int] = {}
+        self._delays: dict[tuple[str, str], tuple[int, float]] = {}
         self._lock = threading.Lock()
         self.calls: list[tuple[str, str, str]] = []  # (src, dst, type) trace
+        # Duplications actually DELIVERED (handler ran twice) — distinct
+        # from charges consumed by requests that also hit a block/drop.
+        # The chaos checker keys its exactly-once suspension on this.
+        self.dups_applied = 0
 
     # -- server side --
     def register(self, addr: str, handler: Handler) -> None:
@@ -107,15 +125,36 @@ class InProcNetwork:
         with self._lock:
             self._blocked.discard(frozenset((a, b)))
 
+    def block_oneway(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._blocked_oneway.add((src, dst))
+
+    def unblock_oneway(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._blocked_oneway.discard((src, dst))
+
     def heal(self) -> None:
         with self._lock:
             self._blocked.clear()
+            self._blocked_oneway.clear()
             self._down.clear()
             self._drops.clear()
+            self._dups.clear()
+            self._delays.clear()
 
     def drop_next(self, src: str, dst: str, n: int = 1) -> None:
         with self._lock:
             self._drops[(src, dst)] = self._drops.get((src, dst), 0) + n
+
+    def dup_next(self, src: str, dst: str, n: int = 1) -> None:
+        with self._lock:
+            self._dups[(src, dst)] = self._dups.get((src, dst), 0) + n
+
+    def delay_next(self, src: str, dst: str, n: int = 1,
+                   delay_s: float = 0.05) -> None:
+        with self._lock:
+            left, _ = self._delays.get((src, dst), (0, 0.0))
+            self._delays[(src, dst)] = (left + n, float(delay_s))
 
     # -- client side --
     def client(self, src_addr: str = "client") -> "InProcClient":
@@ -125,20 +164,44 @@ class InProcNetwork:
         with self._lock:
             handler = self._handlers.get(dst)
             down = dst in self._down or src in self._down
-            blocked = frozenset((src, dst)) in self._blocked
+            blocked = (frozenset((src, dst)) in self._blocked
+                       or (src, dst) in self._blocked_oneway)
             pending_drops = self._drops.get((src, dst), 0)
             if pending_drops:
                 self._drops[(src, dst)] = pending_drops - 1
+            dup = 0
+            pending_dups = self._dups.get((src, dst), 0)
+            if pending_dups:
+                self._dups[(src, dst)] = pending_dups - 1
+                dup = 1
+            delay_s = 0.0
+            pending_delays, d = self._delays.get((src, dst), (0, 0.0))
+            if pending_delays:
+                self._delays[(src, dst)] = (pending_delays - 1, d)
+                delay_s = d
             self.calls.append((src, dst, str(request.get("type"))))
         if handler is None or down:
             raise RpcError(f"{dst}: connection refused")
         if blocked or pending_drops:
             raise RpcTimeout(f"{src}->{dst}: timed out after {timeout}s")
+        if delay_s > 0:
+            # Synchronous by design: the slow link stalls the CALLER, the
+            # same head-of-line effect a real slow socket produces.
+            time.sleep(delay_s)
         # Round-trip through the codec so in-proc tests exercise the same
         # encoding constraints as real sockets (no sharing of mutables).
         wire_req = codec.decode(codec.encode(request))
         try:
             resp = handler(wire_req)
+            if dup:
+                # At-least-once delivery: the handler sees the request
+                # again (fresh decode — no shared mutables between the
+                # two executions); only the LAST response reaches the
+                # caller, like a client retry whose first response was
+                # lost in flight.
+                resp = handler(codec.decode(codec.encode(request)))
+                with self._lock:
+                    self.dups_applied += 1
         except Exception as e:  # handler bug → application error, not crash
             resp = {"ok": False, "error": f"internal: {type(e).__name__}: {e}"}
         return codec.decode(codec.encode(resp))

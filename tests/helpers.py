@@ -9,18 +9,15 @@ from ripplemq_tpu.core.state import StepInput
 
 
 def small_cfg(**kw) -> EngineConfig:
-    base = dict(
-        partitions=4,
-        replicas=3,
-        slots=64,
-        slot_bytes=32,
-        max_batch=8,
-        read_batch=8,
-        max_consumers=8,
-        max_offset_updates=4,
-    )
-    base.update(kw)
-    return EngineConfig(**base)
+    """Small-dimension engine config — ONE definition, library-resident
+    (the chaos cluster harness uses the same shape; keeping a second
+    copy here would let the unit suites and the chaos plane silently
+    drift onto different engine shapes)."""
+    from ripplemq_tpu.chaos.cluster import small_engine
+
+    kw.setdefault("partitions", 4)
+    kw.setdefault("replicas", 3)
+    return small_engine(kw.pop("partitions"), kw.pop("replicas"), **kw)
 
 
 def make_input(

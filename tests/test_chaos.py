@@ -1,0 +1,188 @@
+"""Fixed-seed chaos smoke (tier-1): the acceptance gate for issue 2.
+
+Across >= 3 distinct seeds of crash/partition/delay/dup schedules, the
+end-to-end safety checker must report ZERO violations (no acked loss,
+committed-prefix and offset monotonicity, no phantoms, bounded
+re-convergence after heal), and the fault trace must be byte-for-byte
+reproducible from the seed alone.
+
+The schedules here are real adversaries — each seed's two phases mix
+broker crashes (controller included), isolation, symmetric/one-way
+partitions, drops, delays, and duplication — but the run shape is kept
+small (3 brokers, 2 partitions, ~0.5 s faulted windows) so the whole
+module fits the tier-1 budget; the open-ended randomized soak lives in
+test_chaos_soak.py (slow)."""
+
+from __future__ import annotations
+
+import pytest
+
+from ripplemq_tpu.chaos.history import check_history
+from ripplemq_tpu.chaos.nemesis import (
+    expected_trace,
+    make_schedule,
+    trace_json,
+)
+
+SMOKE_SEEDS = (1, 3, 7)
+PHASES = 2
+
+
+@pytest.mark.parametrize("seed", SMOKE_SEEDS)
+def test_fixed_seed_chaos_smoke(seed):
+    from ripplemq_tpu.chaos import run_chaos
+
+    verdict = run_chaos(seed=seed, phases=PHASES, phase_s=0.5)
+    assert verdict["violations"] == [], (
+        f"seed {seed} safety violations: {verdict['violations']}\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )
+    assert verdict["converged"], (
+        f"seed {seed} never re-converged after heal: "
+        f"{verdict['convergence']}"
+    )
+    # The workload actually exercised the cluster through the faults.
+    # Mid-run consume/delivery counts are contention-sensitive (a
+    # consumer can spend a short faulted run inside retry stalls), so
+    # the stable end-to-end read proof is the final DRAIN — which also
+    # feeds the checker; per-read invariants still apply to every
+    # consume that did happen.
+    assert verdict["counts"]["produce_ok"] > 0
+    assert sum(verdict["final_log_sizes"].values()) > 0
+    # Byte-for-byte trace reproducibility: the applied trace equals the
+    # pure-function expansion of the seed's schedule — rerunning the
+    # same seed replays the identical fault trace.
+    sched = make_schedule(seed, [0, 1, 2], PHASES, ops_per_phase=2)
+    assert trace_json(verdict["trace"]) == trace_json(expected_trace(sched))
+
+
+def test_schedule_is_a_pure_function_of_the_seed():
+    for seed in (0, 1, 2, 3, 42, 1337):
+        a = make_schedule(seed, [0, 1, 2], phases=4, ops_per_phase=3)
+        b = make_schedule(seed, [0, 1, 2], phases=4, ops_per_phase=3)
+        assert trace_json(expected_trace(a)) == trace_json(expected_trace(b))
+    # Distinct seeds diverge (the space is not degenerate).
+    traces = {
+        trace_json(expected_trace(
+            make_schedule(s, [0, 1, 2], phases=4, ops_per_phase=3)
+        ))
+        for s in range(8)
+    }
+    assert len(traces) > 1
+
+
+def test_schedule_never_crashes_the_majority():
+    for seed in range(25):
+        for n in (3, 5):
+            sched = make_schedule(seed, list(range(n)), phases=3,
+                                  ops_per_phase=4)
+            for ops in sched:
+                crashed = {op["broker"] for op in ops
+                           if op["op"] == "crash"}
+                assert len(crashed) <= (n - 1) // 2, (seed, n, ops)
+
+
+def test_lockstep_worker_kill_op():
+    """With a lockstep worker roster the schedule pool includes
+    kill_worker, and applying it downs the worker endpoint (exercising
+    the broken-plane → abdication path in a lockstep deployment)."""
+    from ripplemq_tpu.chaos.cluster import make_cluster_config
+    from ripplemq_tpu.chaos.nemesis import Nemesis
+    from ripplemq_tpu.wire import InProcNetwork
+
+    assert any(
+        op["op"] == "kill_worker"
+        for seed in range(40)
+        for ops in make_schedule(seed, [0, 1, 2], phases=2,
+                                 ops_per_phase=3,
+                                 lockstep_workers=("w0", "w1"))
+        for op in ops
+    ), "kill_worker never drawn from the lockstep op pool"
+
+    class _Stub:
+        config = make_cluster_config(3)
+        net = InProcNetwork()
+        brokers = {0: None, 1: None, 2: None}
+
+    stub = _Stub()
+    nem = Nemesis(stub, seed=0, phases=1, lockstep_workers=("w0",),
+                  schedule=[[{"op": "kill_worker", "worker": "w0"}]])
+    nem.run_phase(0)
+    assert "w0" in stub.net._down
+    nem.heal_phase(0)
+    assert "w0" not in stub.net._down
+
+
+# ------------------------------------------------------- checker unit tests
+
+def _produce(payload, status="ok", attempts=1, pid=0):
+    return {"op": "produce", "client": "p", "topic": "t", "partition": pid,
+            "payload": payload, "status": status, "attempts": attempts}
+
+
+def test_checker_flags_acked_loss():
+    ops = [_produce("a"), _produce("b")]
+    v = check_history(ops, {("t", 0): ["a"]})
+    assert len(v) == 1 and "acked loss" in v[0] and "'b'" in v[0]
+
+
+def test_checker_flags_phantom_and_clean_dup():
+    ops = [_produce("a")]
+    v = check_history(ops, {("t", 0): ["a", "a", "ghost"]})
+    kinds = "".join(v)
+    assert "phantom" in kinds and "duplicate beyond contract" in kinds
+    # Wire duplication in the schedule legitimizes the dup (at-least-once
+    # delivery, no idempotent producer id) but never the phantom.
+    v = check_history(ops, {("t", 0): ["a", "a", "ghost"]},
+                      allow_wire_dups=True)
+    assert any("phantom" in x for x in v)
+    assert not any("duplicate" in x for x in v)
+
+
+def test_checker_allows_retried_duplicates_and_unknown_absence():
+    ops = [
+        _produce("a", attempts=3),        # retried: may duplicate
+        _produce("b", status="unknown"),  # in-flight at crash: may be lost
+        _produce("c", status="fail"),     # nacked: may still have landed
+    ]
+    assert check_history(ops, {("t", 0): ["a", "a", "c"]}) == []
+
+
+def test_checker_flags_order_violation():
+    ops = [
+        _produce("a"), _produce("b"),
+        {"op": "consume", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 0, "next_offset": 2,
+         "payloads": ["b", "a"]},
+    ]
+    v = check_history(ops, {("t", 0): ["a", "b"]})
+    assert any("order violation" in x for x in v)
+
+
+def test_checker_flags_offset_regression_and_redelivery():
+    ops = [
+        _produce("a"), _produce("b"),
+        {"op": "consume", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 0, "next_offset": 4, "payloads": ["a"]},
+        {"op": "commit", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 4},
+        # Redelivery below the acked commit: at-most-once violation.
+        {"op": "consume", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 0, "next_offset": 4, "payloads": ["a"]},
+    ]
+    v = check_history(ops, {("t", 0): ["a", "b"]})
+    assert any("redelivery below acked commit" in x for x in v)
+    assert any("offset went backward" in x for x in v)
+
+
+def test_checker_passes_clean_history():
+    ops = [
+        _produce("a"), _produce("b", pid=1),
+        {"op": "consume", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 0, "next_offset": 4, "payloads": ["a"]},
+        {"op": "commit", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 4},
+        {"op": "consume", "client": "c", "topic": "t", "partition": 0,
+         "status": "ok", "offset": 4, "next_offset": 4, "payloads": []},
+    ]
+    assert check_history(ops, {("t", 0): ["a"], ("t", 1): ["b"]}) == []

@@ -1,0 +1,49 @@
+"""Long randomized chaos soak (slow tier): wider clusters, deeper
+schedules, many seeds. The fixed-seed tier-1 gate lives in
+test_chaos.py; this module is the open-ended adversary — run it when
+touching consensus, replication, retry, or failover code:
+
+    pytest tests/test_chaos_soak.py -m slow -q
+
+Every failure prints the seed and the byte-reproducible fault trace;
+`python profiles/chaos_soak.py --seed N` replays it outside pytest."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from ripplemq_tpu.chaos import run_chaos
+from ripplemq_tpu.chaos.nemesis import trace_json
+
+pytestmark = pytest.mark.slow
+
+# Deterministic default sweep; override for a broader hunt:
+#   CHAOS_SOAK_SEEDS="100:140" pytest tests/test_chaos_soak.py -m slow
+_spec = os.environ.get("CHAOS_SOAK_SEEDS", "0:8")
+_lo, _hi = (int(x) for x in _spec.split(":"))
+SOAK_SEEDS = range(_lo, _hi)
+
+
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_randomized_soak_seed(seed):
+    verdict = run_chaos(
+        seed=seed,
+        n_brokers=5,
+        partitions=3,
+        phases=4,
+        phase_s=0.8,
+        ops_per_phase=3,
+        converge_timeout_s=60.0,
+    )
+    assert verdict["violations"] == [], (
+        f"seed {seed}: {verdict['violations']}\n"
+        f"replay: python profiles/chaos_soak.py --seed {seed} "
+        f"--brokers 5 --partitions 3 --phases 4 --ops-per-phase 3\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )
+    assert verdict["converged"], (
+        f"seed {seed} unconverged: {verdict['convergence']}\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )

@@ -213,11 +213,24 @@ def test_rf_equals_cluster_size_death_still_reelects(tmp_path):
                 not in (None, ctrl),
                 timeout=60,
             ), f"partition {pid} never re-elected"
-            leader = survivor.manager.leader_of(("t", pid))
-            resp = client.call(
-                c.brokers[leader].addr,
-                {"type": "produce", "topic": "t", "partition": pid,
-                 "messages": [b"rf-n-%d" % pid]},
-                timeout=30.0,
-            )
+            # Re-elected leadership can be advertised a beat before the
+            # promoted plane's control tables settle: poll retryable
+            # refusals (not_committed / not_leader) like a real client's
+            # RetryPolicy; nothing partially commits, so retries stay
+            # duplicate-free.
+            deadline = time.time() + 60
+            while True:
+                leader = survivor.manager.leader_of(("t", pid))
+                resp = client.call(
+                    c.brokers[leader].addr,
+                    {"type": "produce", "topic": "t", "partition": pid,
+                     "messages": [b"rf-n-%d" % pid]},
+                    timeout=30.0,
+                )
+                if resp.get("ok") or time.time() > deadline:
+                    break
+                assert ("not_committed" in resp.get("error", "")
+                        or "not_leader" in resp.get("error", "")), resp
+                assert resp.get("committed", 0) == 0, resp
+                time.sleep(0.1)
             assert resp["ok"], resp

@@ -36,12 +36,14 @@ FAST_MODULES = {
     "test_append_kernel",      # ~2 min: Mosaic-interpreter kernel parity
     "test_broker",
     "test_chain",
+    "test_chaos",               # ~20 s: fixed-seed chaos smoke (3 seeds)
     "test_client",
     "test_cold_restart",
     "test_control_fusion",
     "test_controller_failover",
     "test_core_step",
     "test_dataplane",
+    "test_degradation",
     "test_failover",
     "test_graft",
     "test_hostraft",
@@ -57,6 +59,7 @@ FAST_MODULES = {
     "test_read_cache",
     "test_readme_bench",
     "test_retention",
+    "test_retry_policy",
     "test_rs",
     "test_shard_distribution",
     "test_soak",                # ~15 s: the bounded hand-written soak
@@ -117,6 +120,6 @@ def test_known_soaks_stay_slow_marked():
     """The modules that took the seed's tier-1 over its timeout must
     keep their marks (deleting a mark reintroduces the timeout)."""
     for name in ("test_multihost", "test_soak_random", "test_soak_gc",
-                 "test_lockstep_drill"):
+                 "test_lockstep_drill", "test_chaos_soak"):
         path = TESTS_DIR / f"{name}.py"
         assert _is_slow_marked(path), f"{name} lost its slow mark"

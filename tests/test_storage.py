@@ -197,9 +197,23 @@ def test_broker_restart_recovers_messages_and_metadata(tmp_path):
     brokers = boot(net)
     client = net.client("c")
     leader = brokers[0].manager.leader_of(("topic1", 0))
-    resp = client.call(brokers[leader].addr,
-                       {"type": "produce", "topic": "topic1", "partition": 0,
-                        "messages": [b"durable-1", b"durable-2"]}, timeout=10)
+    # Leaders can be advertised a beat before the first quorum round
+    # sticks (bootstrap churn): poll retryable refusals like a real
+    # client's RetryPolicy would.
+    deadline = time.time() + 30
+    while True:
+        resp = client.call(brokers[leader].addr,
+                           {"type": "produce", "topic": "topic1",
+                            "partition": 0,
+                            "messages": [b"durable-1", b"durable-2"]},
+                           timeout=10)
+        if resp.get("ok") or time.time() > deadline:
+            break
+        assert ("not_committed" in resp.get("error", "")
+                or "not_leader" in resp.get("error", "")), resp
+        # Nothing partially committed: a blind retry stays duplicate-free.
+        assert resp.get("committed", 0) == 0, resp
+        time.sleep(0.1)
     assert resp["ok"], resp
     resp = client.call(brokers[leader].addr,
                        {"type": "consume", "topic": "topic1", "partition": 0,
@@ -220,10 +234,22 @@ def test_broker_restart_recovers_messages_and_metadata(tmp_path):
     client2 = net2.client("c2")
     try:
         leader2 = brokers2[0].manager.leader_of(("topic1", 0))
+        # The restarted controller boots its plane only after confirming
+        # the recovered metadata with the raft quorum (the stale-
+        # controllership fence, broker/server._metadata_current): until
+        # then requests refuse RETRYABLY (not_committed/not_controller),
+        # exactly what a real client's RetryPolicy absorbs — poll here.
+        deadline = time.time() + 30
+        while True:
+            resp = client2.call(brokers2[leader2].addr,
+                                {"type": "consume", "topic": "topic1",
+                                 "partition": 0, "consumer": "g"}, timeout=10)
+            if resp.get("ok") or time.time() > deadline:
+                break
+            assert ("not_committed" in resp.get("error", "")
+                    or "not_leader" in resp.get("error", "")), resp
+            time.sleep(0.1)
         # Offset survived: consuming as "g" sees nothing new...
-        resp = client2.call(brokers2[leader2].addr,
-                            {"type": "consume", "topic": "topic1",
-                             "partition": 0, "consumer": "g"}, timeout=10)
         assert resp["ok"] and resp["messages"] == [], resp
         # ...while a fresh consumer replays the durable messages.
         resp = client2.call(brokers2[leader2].addr,
