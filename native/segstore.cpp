@@ -196,6 +196,32 @@ int segstore_append(void* h, int type, int slot, int base,
   return segstore_append_at(h, type, slot, base, data, len, nullptr, nullptr);
 }
 
+// Writes one PRE-FRAMED blob (a concatenation of records the caller
+// framed with the same header/crc layout append_at produces) in a
+// single write: the per-record call overhead — ctypes marshalling plus
+// a GIL round-trip per record on the Python side — was measured as the
+// dominant cost of persisting a multi-record round under load. Rotates
+// BEFORE the write when the blob would overflow the active segment, so
+// a blob never straddles two files (callers bound blobs well under
+// segment_bytes). Reports the segment index and the byte offset the
+// blob starts at; the caller derives each record's payload locator from
+// its offset within the blob.
+int segstore_append_blob(void* h, const uint8_t* blob, long len,
+                         int* out_seg, long* out_off) {
+  Store* s = static_cast<Store*>(h);
+  if (!s || s->fd < 0 || len < 0) return -1;
+  if (s->seg_size + len > s->segment_bytes && s->seg_size > 0) {
+    close(s->fd);
+    s->seg_index++;
+    if (open_segment(s) != 0) return -1;
+  }
+  if (out_seg) *out_seg = s->seg_index;
+  if (out_off) *out_off = s->seg_size;
+  if (write_all(s->fd, blob, (size_t)len) != 0) return -1;
+  s->seg_size += len;
+  return 0;
+}
+
 int segstore_flush(void* h) {
   Store* s = static_cast<Store*>(h);
   if (!s || s->fd < 0) return -1;

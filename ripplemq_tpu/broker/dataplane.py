@@ -22,6 +22,7 @@ independent JRaft ballot per group).
 
 from __future__ import annotations
 
+import queue
 import threading
 from concurrent.futures import Future
 from typing import Optional
@@ -149,6 +150,8 @@ class DataPlane:
         chain_depth: int = 4,
         read_q: int = 16,
         host_read_cache: bool = True,
+        settle_window: Optional[int] = None,
+        read_coalesce_s: float = 0.001,
     ) -> None:
         self.cfg = cfg
         # Durability tier: committed rounds are framed into the segment
@@ -230,6 +233,17 @@ class DataPlane:
         # (FencedError ⊂ NotCommittedError → producers retry at the new
         # controller).
         self.replicate_fn = replicate_fn
+        # Pipelined-settle split of replicate_fn (RoundReplicator.begin/
+        # wait): `begin` enqueues a round's records on every standby
+        # stream without blocking; `wait` blocks until all member acks.
+        # When set (the broker wires them beside replicate_fn), a window
+        # of up to `settle_window` rounds streams to the standbys while
+        # the device advances; acks still release strictly in round
+        # order (see _settle_loop). When only replicate_fn is set (tests,
+        # custom replicators), the settle thread calls it synchronously —
+        # same in-order release, no standby-stream overlap.
+        self.replicate_begin_fn = None
+        self.replicate_wait_fn = None
         if mode == "local":
             self.fns = make_local_fns(cfg)
         elif mode == "spmd":
@@ -267,6 +281,7 @@ class DataPlane:
         self.term = np.zeros((P,), np.int32)
         self.alive = np.ones((P, R), bool)
         self.quorum = np.full((P,), cfg.quorum, np.int32)
+        self._refresh_quorum_ok_locked()  # pre-start: no lock needed yet
 
         self._appends: dict[int, list[_Pending]] = {}
         self._offsets: dict[int, list[_PendingOffsets]] = {}
@@ -293,8 +308,6 @@ class DataPlane:
         # log_end only moves on commit), captured in the round ctx. The
         # bounded queue backpressures dispatch at `pipeline_depth`
         # outstanding rounds.
-        import queue as _queue
-
         self.pipeline_depth = max(1, pipeline_depth)
         self.resolver_threads = max(1, resolver_threads)
         # Deep backlogs drain as CHAINS of up to chain_depth rounds per
@@ -317,8 +330,9 @@ class DataPlane:
         # resubmit; draining the instant the first request lands would
         # phase-lock the cohort into half-filled batches (measured: 8/16
         # consumers per dispatch without it). Negligible vs the dispatch
-        # RTT it amortizes.
-        self.read_coalesce_s = 0.001
+        # RTT it amortizes. Constructor/config-surfaced like coalesce_s
+        # (ClusterConfig.read_coalesce_s); 0 disables.
+        self.read_coalesce_s = max(0.0, read_coalesce_s)
         self._reads: list[tuple[int, int, int, Future]] = []
         self._read_lock = threading.Lock()
         self._read_work = threading.Event()
@@ -338,8 +352,8 @@ class DataPlane:
         # host↔device sync to resolve, which dwarfs the window (~100 ms
         # behind a tunnel, ~1 ms attached). 0 disables.
         self.coalesce_s = coalesce_s
-        self._inflight: "_queue.Queue[tuple[StepInput, dict, object]]" = (
-            _queue.Queue(maxsize=self.pipeline_depth)
+        self._inflight: "queue.Queue[tuple[StepInput, dict, object]]" = (
+            queue.Queue(maxsize=self.pipeline_depth)
         )
         self._resolvers = [
             threading.Thread(
@@ -348,6 +362,51 @@ class DataPlane:
             )
             for i in range(self.resolver_threads)
         ]
+        # --- settle pipeline (third stage) -------------------------------
+        # Resolvers no longer block on standby replication: each resolved
+        # dispatch enters a bounded settle window — its records already
+        # streaming to the standbys (replicate_begin_fn) — and ONE settle
+        # thread waits out the acks strictly in dispatch order before
+        # persisting, mirroring, advancing the settled-read horizon, and
+        # releasing producer futures. Ordering invariants this preserves
+        # verbatim: per-slot standby-stream record order (begin happens
+        # inside the dispatch-order turnstile), settle-gated reads
+        # (_settled_end moves only here, in order), ack-only-after-all-
+        # member-acks (replicate_wait_fn runs the full waiver/fence
+        # discipline), and the empty-set refusal (begin raises it). The
+        # window backpressures resolvers when full; a FencedError latches
+        # `_settle_fenced` and DRAINS the window without acking any
+        # unsettled round (a deposed controller's pre-received standby
+        # acks prove nothing against the successor's history).
+        self.settle_window = max(
+            1, cfg.settle_window if settle_window is None
+            else int(settle_window)
+        )
+        # The window bound is the SEMAPHORE (held from replication begin
+        # until release completes), not the queue: a bounded queue alone
+        # would let one extra round begin streaming while blocked on the
+        # put, making settle_window=1 overlap instead of serialize.
+        self._settle_q: "queue.Queue[tuple]" = queue.Queue()
+        self._settle_sem = threading.Semaphore(self.settle_window)
+        self._settle_thread = threading.Thread(
+            target=self._settle_loop, daemon=True, name="dataplane-settle"
+        )
+        self._settle_fenced = False
+        # Dispatch-order turnstile: resolvers run concurrently, but
+        # settle-pipeline entry (and the replication begin inside it)
+        # must follow dispatch order or a slot's standby stream could
+        # carry round k+1's records before round k's (standby replay is
+        # later-record-wins per slot — a reordered stream would regress
+        # its log end). Seqs are assigned by the step thread.
+        self._dispatch_seq = 0
+        self._next_turn = 0
+        self._turnstile = threading.Condition()
+        # Occupancy counters (bench/admin surface): depth is sampled at
+        # each settle enqueue; backpressure counts enqueues that found
+        # the window full.
+        self.settle_depth_sum = 0
+        self.settle_samples = 0
+        self.settle_backpressure = 0
         # Guarded by self._lock (read by _drain, cleared by the resolver).
         self._busy_a: set[int] = set()   # partition slots with appends in flight
         self._busy_o: set[int] = set()   # ... with offset commits in flight
@@ -372,6 +431,7 @@ class DataPlane:
         self._read_thread.start()
         for r in self._resolvers:
             r.start()
+        self._settle_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -380,10 +440,23 @@ class DataPlane:
         # A never-started plane (boot failed between construction and
         # start — server._boot_dataplane's cleanup path) must still run
         # the rest of stop (fail queued futures, flush): joining an
-        # unstarted Thread raises, so join only what ran.
-        for t in (self._thread, self._read_thread, *self._resolvers):
+        # unstarted Thread raises, so join only what ran. The settle
+        # thread joins LAST — it exits only once the resolvers are dead
+        # and the window is drained.
+        for t in (self._thread, self._read_thread, *self._resolvers,
+                  self._settle_thread):
             if t.ident is not None:
                 t.join(timeout=10)  # lands every dispatched round
+        # Stranded settle entries (settle thread wedged past its join
+        # timeout, or never started): fail their committed futures —
+        # nothing will release them now.
+        while True:
+            try:
+                ctx, committed, *_ = self._settle_q.get_nowait()
+            except queue.Empty:
+                break
+            self._fail_committed(ctx, committed,
+                                 NotCommittedError("data plane stopped"))
         with self._read_lock:
             stranded = self._reads
             self._reads = []
@@ -423,6 +496,7 @@ class DataPlane:
             raise ValueError(f"alive mask must be [P, R], got {alive.shape}")
         with self._lock:
             self.alive = alive.copy()
+            self._refresh_quorum_ok_locked()
 
     def set_quorum(self, quorum: np.ndarray) -> None:
         """Install per-partition quorum sizes (RF//2+1 per topic)."""
@@ -431,6 +505,16 @@ class DataPlane:
             raise ValueError(f"quorum must be [P], got {quorum.shape}")
         with self._lock:
             self.quorum = quorum.copy()
+            self._refresh_quorum_ok_locked()
+
+    def _refresh_quorum_ok_locked(self) -> None:
+        # Plain python list, swapped whole: quorum_lost() runs on EVERY
+        # consume/offset-commit, and a per-call numpy sum under the
+        # control lock measurably contends with the drain loop at high
+        # request rates (sampled hot in the e2e profile).
+        self._quorum_ok = (
+            self.alive.sum(axis=1) >= self.quorum
+        ).tolist()
 
     def mirror_gap_slots(self) -> int:
         """Count of slots whose host mirror is gap-disabled (resolve
@@ -444,9 +528,10 @@ class DataPlane:
         """True iff partition `slot` cannot commit ANY round right now:
         fewer replica slots alive than its quorum. Rounds for such a
         slot are doomed before dispatch, so callers fast-fail with a
-        typed `unavailable` refusal instead of burning an RPC timeout."""
-        with self._lock:
-            return int(self.alive[slot].sum()) < int(self.quorum[slot])
+        typed `unavailable` refusal instead of burning an RPC timeout.
+        Lock-free: reads the precomputed list set_alive/set_quorum swap
+        in whole (list indexing is atomic under the GIL)."""
+        return not self._quorum_ok[slot]
 
     def degraded_slots(self) -> list[int]:
         """Partitions whose quorum is currently lost ([P]-masked under
@@ -533,27 +618,32 @@ class DataPlane:
                 )
             )
             return fut
-        for m in payloads:
-            if not isinstance(m, (bytes, bytearray, memoryview)):
-                fut.set_exception(
-                    TypeError(f"payloads must be bytes, got {type(m).__name__}")
-                )
-                return fut
-            if len(m) == 0:
+        # Bulk validation (this runs per batch on RPC worker threads —
+        # a per-message three-check python loop was a measurable slice
+        # of the produce path's CPU): min/max are C-speed passes, and
+        # non-bytes payloads fail pack_payload_rows's buffer coercion.
+        try:
+            lens = [len(m) for m in payloads]
+            if min(lens) == 0:
                 fut.set_exception(
                     ValueError("empty messages are not supported (length-0 "
                                "rows mark alignment padding)")
                 )
                 return fut
-            if len(m) > cfg.payload_bytes:
+            if max(lens) > cfg.payload_bytes:
                 fut.set_exception(
                     ValueError(
-                        f"payload of {len(m)} bytes exceeds payload_bytes "
+                        f"payload of {max(lens)} bytes exceeds payload_bytes "
                         f"{cfg.payload_bytes}"
                     )
                 )
                 return fut
-        rows = pack_payload_rows(self.cfg, payloads)  # off-lock packing
+            rows = pack_payload_rows(self.cfg, payloads)  # off-lock packing
+        except TypeError as e:
+            fut.set_exception(
+                TypeError(f"payloads must be bytes: {e}")
+            )
+            return fut
         with self._lock:
             if self._log_end[slot] >= _OFFSET_HORIZON:
                 fut.set_exception(
@@ -705,12 +795,15 @@ class DataPlane:
         (messages, next_offset) result, None to fall through to the
         device (mirror gap after a resolve failure), or _CACHE_LAPPED
         when trim overran the window mid-copy (caller retries; the next
-        pass store-serves). An offset at-or-past the committed end
-        answers empty WITHOUT device dispatch — the log-end shadow is
-        commit-exact, so tail polls are host-authoritative too."""
+        pass store-serves). An offset at-or-past the SETTLED end answers
+        empty WITHOUT device dispatch: reads may never see past the
+        settled horizon anyway (a device dispatch would clamp to it and
+        return the same emptiness), so tail polls stay host-authoritative
+        even while the settle pipeline holds committed-but-unsettled
+        rounds in flight."""
         S = self.cfg.slots
         with self._lock:
-            end = int(self._log_end[slot])
+            end = int(self._settled_end[slot])
             cend = int(self._cache_end[slot])
             dirty = slot in self._shadow_dirty
         if dirty:
@@ -1432,6 +1525,11 @@ class DataPlane:
                 with self._lock:
                     self._busy_a |= ctx["appends"].keys()
                     self._busy_o |= ctx["offsets"].keys()
+                # Settle-pipeline turn: assigned only to dispatches that
+                # reach the resolvers (a seq that never arrives would
+                # stall the turnstile forever).
+                ctx["seq"] = self._dispatch_seq
+                self._dispatch_seq += 1
                 # Blocks at pipeline_depth outstanding rounds (backpressure).
                 self._inflight.put((inp, ctx, out))
                 ctx = None  # now owned by the resolver
@@ -1456,23 +1554,29 @@ class DataPlane:
         """Resolver thread: land rounds — several run concurrently, so
         landing order is only guaranteed PER SLOT (in-flight rounds touch
         disjoint slots; see the pipeline comment in __init__), not across
-        slots."""
-        import queue as _queue
-
+        slots. Resolvers stop at the settle handoff: the blocking
+        standby-ack wait lives in the settle thread (_settle_loop)."""
         while True:
             try:
                 item = self._inflight.get(timeout=0.05)
-            except _queue.Empty:
+            except queue.Empty:
                 if self._stop.is_set() and not self._thread.is_alive():
                     return
                 continue
             self._resolve_one(*item)
 
     def _resolve_one(self, inp: StepInput, ctx: dict, out) -> None:
-        """Fetch one round's outputs (blocking) and settle its futures.
-        Failures fail that round's futures only. The slot stays busy
-        until AFTER _settle so retry requeues land at the queue front
-        before drain can take later submits for the same slot."""
+        """Fetch one dispatch's outputs (blocking), nack/requeue its
+        UNCOMMITTED rounds, and hand the committed work to the settle
+        pipeline. Fetch failures fail the whole dispatch here. The
+        uncommitted nack runs while the slots are still busy, so retry
+        requeues land at the queue front before drain can take later
+        submits for the same slot (per-slot FIFO); the busy bits then
+        clear at the settle HANDOFF — the device may advance a slot
+        whose standby replication is still in flight (the pipelined
+        settle window), with reads gated on _settled_end as ever."""
+        seq = ctx["seq"]
+        entry = None
         try:
             committed = np.asarray(out.committed)  # the ONE device fetch
             if committed.ndim == 1:
@@ -1494,7 +1598,111 @@ class DataPlane:
                         if committed[k, slot] and n > 0:
                             adv = -(-n // ALIGN) * ALIGN
                             self._log_end[slot] = rc["bases"][slot] + adv
-            # Replicate BEFORE the local persist: the local store must
+            # Nack in REVERSE round order: failed pendings requeue at
+            # the queue FRONT, so the earliest round's retries must be
+            # inserted last to land first. Pad charging belongs to the
+            # LAST chained round per slot (see _settle_round).
+            last_round = {
+                slot: k
+                for k, rc in enumerate(chain)
+                for slot in rc["appends"]
+            }
+            for k in range(len(chain) - 1, -1, -1):
+                rc = chain[k]
+                rc["charge_pads"] = {
+                    s for s in rc["appends"] if last_round[s] == k
+                }
+                self._settle_round(rc, rc["bases"], committed[k], ack=False)
+            entry = (ctx, committed, records)
+        except Exception as e:
+            with self._lock:
+                self.step_errors += 1
+                # The round's device outcome may be unknown (the
+                # committed fetch itself failed): re-derive these slots'
+                # shadow from the device before their next round.
+                self._shadow_dirty |= ctx["appends"].keys()
+            log.warning("round resolve error: %s: %s", type(e).__name__, e)
+            self._fail_round(ctx, e)
+        # Dispatch-order turnstile (see __init__): replication begin and
+        # settle-queue entry must follow dispatch order even though
+        # resolvers complete out of order. Failed dispatches still take
+        # and release their turn, or the sequence would stall.
+        with self._turnstile:
+            while self._next_turn != seq:
+                self._turnstile.wait(timeout=0.5)
+        try:
+            if entry is not None:
+                self._enqueue_settle(entry)
+        finally:
+            with self._turnstile:
+                self._next_turn = seq + 1
+                self._turnstile.notify_all()
+            with self._lock:
+                self._busy_a -= ctx["appends"].keys()
+                self._busy_o -= ctx["offsets"].keys()
+
+    def _enqueue_settle(self, entry: tuple) -> None:
+        """Start the entry's standby replication (non-blocking when the
+        replicator supports begin/wait) and push it into the bounded
+        settle window. Called inside the dispatch-order turnstile, so
+        the per-slot standby stream order equals dispatch order. Blocks
+        when the window is full — the backpressure that bounds how far
+        the device may run ahead of standby acks."""
+        ctx, committed, records = entry
+        # Window slot FIRST (backpressure: the device may run at most
+        # settle_window rounds ahead of the standby acks), then begin.
+        if not self._settle_sem.acquire(blocking=False):
+            with self._lock:
+                self.settle_backpressure += 1
+            self._settle_sem.acquire()
+        ticket = exc = None
+        if records and self.replicate_begin_fn is not None:
+            try:
+                ticket = self.replicate_begin_fn(records)
+            except Exception as e:
+                # Fencing/empty-set refusal at begin: carried into the
+                # window so the release stage fails the entry IN ORDER
+                # (acks of earlier rounds still release first).
+                exc = e
+        with self._lock:
+            self.settle_depth_sum += self._settle_q.qsize()
+            self.settle_samples += 1
+        self._settle_q.put((ctx, committed, records, ticket, exc))
+
+    def _settle_loop(self) -> None:
+        """Settle thread: release the window strictly in dispatch order —
+        wait out each entry's standby acks, persist, mirror, advance the
+        settled-read horizon, settle futures. ONE thread by design: the
+        in-order release is what keeps every PR 2 handover invariant
+        intact under pipelining."""
+        while True:
+            try:
+                entry = self._settle_q.get(timeout=0.05)
+            except queue.Empty:
+                if (self._stop.is_set()
+                        and not self._thread.is_alive()
+                        and not any(r.is_alive() for r in self._resolvers)):
+                    return
+                continue
+            self._release_one(*entry)
+
+    def _release_one(self, ctx: dict, committed, records: list,
+                     ticket, exc: Optional[Exception]) -> None:
+        chain = ctx["chain"]
+        try:
+            if self._settle_fenced:
+                # Drain-the-window fence: once deposed, NO later round
+                # of the window may ack — even one whose standby acks
+                # already arrived (they predate the successor epoch and
+                # prove nothing against its history).
+                from ripplemq_tpu.broker.replication import FencedError
+
+                raise FencedError(
+                    "settle window draining: controller deposed"
+                )
+            if exc is not None:
+                raise exc
+            # Ack barrier BEFORE the local persist: the local store must
             # only ever contain standby-acked records, or a controller
             # crash between persist and replicate leaves a record that
             # exists NOWHERE else — its restart-recovery then replays
@@ -1506,14 +1714,18 @@ class DataPlane:
             # the round everywhere EXCEPT the standby stores, whose
             # replay is later-record-wins — the retry's re-append at the
             # same base supersedes the orphaned copy.
-            if self.replicate_fn is not None and records:
+            if ticket is not None:
+                self.replicate_wait_fn(ticket)
+            elif records and self.replicate_fn is not None:
+                # No begin/wait split available (plain replicate_fn):
+                # synchronous, still strictly in release order.
                 self.replicate_fn(records)
             self._persist_round(records)
             # ---- DURABLY SETTLED from here: the round is persisted AND
             # standby-acked. Only now may readers see its effects —
             # mirror rows (the _cache_end advance admits cache readers),
             # the settled-read horizon, and the consumer-offset shadow.
-            # Advancing any of these before replicate() succeeded served
+            # Advancing any of these before the acks landed served
             # state that a controller failover then rolled back: the
             # seeded chaos soak caught it as an acked-commit offset
             # REGRESSION across a promotion (read 24, failover, read 16)
@@ -1522,7 +1734,10 @@ class DataPlane:
             # (Residual window: rows of a replication-FAILED round that
             # the ring recycles within this controller's lifetime are
             # store-served below trim — local-store consistent, and only
-            # nacked data; acked state never regresses.)
+            # nacked data; acked state never regresses. Pipelining widens
+            # the cases that can create such rows — ROADMAP's per-slot
+            # settled-gap structure remains the full fix if soaks flag
+            # it.)
             self._mirror_records(records)
             with self._lock:
                 for k, rc in enumerate(chain):
@@ -1538,34 +1753,20 @@ class DataPlane:
                             for pend in taken_off:
                                 for cs, off in pend.payloads:
                                     self._offsets_shadow[slot, cs] = off
-            # Settle in REVERSE round order: failed pendings requeue at
-            # the queue FRONT, so the earliest round's retries must be
-            # inserted last to land first. Pad charging belongs to the
-            # LAST chained round per slot (see _settle).
-            last_round = {
-                slot: k
-                for k, rc in enumerate(chain)
-                for slot in rc["appends"]
-            }
             for k in range(len(chain) - 1, -1, -1):
-                rc = chain[k]
-                rc["charge_pads"] = {
-                    s for s in rc["appends"] if last_round[s] == k
-                }
-                self._settle(rc, rc["bases"], committed[k])
+                self._settle_round(chain[k], chain[k]["bases"],
+                                   committed[k], ack=True)
         except Exception as e:
+            from ripplemq_tpu.broker.replication import FencedError
+
+            if isinstance(e, FencedError):
+                self._settle_fenced = True
             with self._lock:
                 self.step_errors += 1
-                # The round's device outcome may be unknown (e.g. the
-                # committed fetch itself failed): re-derive these slots'
-                # shadow from the device before their next round.
-                self._shadow_dirty |= ctx["appends"].keys()
-            log.warning("round resolve error: %s: %s", type(e).__name__, e)
-            self._fail_round(ctx, e)
+            log.warning("round settle error: %s: %s", type(e).__name__, e)
+            self._fail_committed(ctx, committed, e)
         finally:
-            with self._lock:
-                self._busy_a -= ctx["appends"].keys()
-                self._busy_o -= ctx["offsets"].keys()
+            self._settle_sem.release()
 
     def _mirror_records(self, records) -> None:
         """Write committed append rows into the host ring mirror at
@@ -1638,22 +1839,42 @@ class DataPlane:
 
     def _persist_round(self, records) -> None:
         """Frame this round's committed records into the segment store
-        and index the append records for the retention read path."""
+        and index the append records for the retention read path. The
+        whole round goes down as ONE batched store write when the store
+        supports it (SegmentStore.append_many) — per-record appends paid
+        a call/GIL round-trip each, which under load was the settle
+        stage's dominant cost."""
         if self.store is None or not records:
             return
-        for rec_type, slot, base, payload in records:
-            locator = self.store.append(rec_type, slot, base, payload)
-            if rec_type == REC_APPEND and self.log_index is not None:
+        append_many = getattr(self.store, "append_many", None)
+        if append_many is not None:
+            locators = append_many(records)
+        else:
+            locators = [self.store.append(*rec) for rec in records]
+        if self.log_index is not None:
+            ends: list[tuple[int, int]] = []
+            for (rec_type, slot, base, payload), locator in zip(
+                records, locators
+            ):
+                if rec_type != REC_APPEND:
+                    continue
                 nrows = len(payload) // self.cfg.slot_bytes
                 self.log_index.add(slot, base, nrows, locator)
+                ends.append((slot, base + nrows))
+            if ends:
                 with self._lock:
                     # Only a SUCCESSFUL append moves the persisted
                     # watermark (the trim clamp's authority).
-                    if base + nrows > self._persisted[slot]:
-                        self._persisted[slot] = base + nrows
+                    for slot, end in ends:
+                        if end > self._persisted[slot]:
+                            self._persisted[slot] = end
         now = time.monotonic()
         if now - self._last_flush >= self.flush_interval_s:
-            self.store.flush()
+            # Deferred fsync (same durability lag contract — see
+            # SegmentStore.flush_async): the settle thread must not
+            # spend its capacity inside the filesystem's fsync latency.
+            flush = getattr(self.store, "flush_async", self.store.flush)
+            flush()
             self._last_flush = now
 
     def install(self, image: ReplicaState) -> None:
@@ -1685,7 +1906,7 @@ class DataPlane:
         log.info("installed recovered image: %d partitions with data, "
                  "max log end %d", int((ends > 0).sum()), int(ends.max()))
 
-    def _fail_round(self, ctx, exc: Exception) -> None:
+    def _wrap_engine_exc(self, exc: Exception) -> Exception:
         if not isinstance(exc, NotCommittedError):
             if self.broken_reason is not None:
                 # Producers must see a RETRYABLE refusal (retry lands on
@@ -1698,6 +1919,12 @@ class DataPlane:
                 # was restored, the next round can succeed): same typed
                 # refusal, same client retry path.
                 exc = NotCommittedError(f"transient engine failure: {exc}")
+        return exc
+
+    def _fail_round(self, ctx, exc: Exception) -> None:
+        """Fail EVERY future of one dispatch (outcome unknown: dispatch
+        or committed-fetch failure — nothing was requeued)."""
+        exc = self._wrap_engine_exc(exc)
         for taken in ctx["appends"].values():
             for pend, _, _ in taken:
                 if not pend.future.done():
@@ -1707,50 +1934,105 @@ class DataPlane:
                 if not pend.future.done():
                     pend.future.set_exception(exc)
 
-    def _settle(self, ctx, base: dict, committed) -> None:
+    def _fail_committed(self, ctx, committed, exc: Exception) -> None:
+        """Fail only the COMMITTED rounds' futures of one dispatch
+        (settle-stage failure: replication refused or failed). The
+        uncommitted rounds were already nacked/requeued by the resolver
+        — their pendings may be live in the queues again and must not
+        be touched."""
+        exc = self._wrap_engine_exc(exc)
+        for k, rc in enumerate(ctx["chain"]):
+            for slot, taken in rc["appends"].items():
+                if not committed[k, slot]:
+                    continue
+                for pend, _, _ in taken:
+                    if not pend.future.done():
+                        pend.future.set_exception(exc)
+            for slot, taken_off in rc["offsets"].items():
+                if not committed[k, slot]:
+                    continue
+                for pend in taken_off:
+                    if not pend.future.done():
+                        pend.future.set_exception(exc)
+
+    def settle_stats(self) -> dict:
+        """Settle-pipeline occupancy snapshot (bench/admin surface):
+        mean window depth sampled at each enqueue, plus how many
+        enqueues found the window full (backpressure engaged)."""
+        with self._lock:
+            samples = self.settle_samples
+            return {
+                "window": self.settle_window,
+                "occupancy_mean": (
+                    round(self.settle_depth_sum / samples, 3)
+                    if samples else 0.0
+                ),
+                "samples": samples,
+                "backpressure_waits": self.settle_backpressure,
+            }
+
+    def _settle_round(self, ctx, base: dict, committed, ack: bool) -> None:
+        """One round's future settlement, in two phases. `ack=False`
+        (resolver, slots still busy): nack/requeue the round's
+        UNCOMMITTED work so retries reach the queue front before later
+        submits drain. `ack=True` (settle thread, strictly in dispatch
+        order after the standby acks landed): release the COMMITTED
+        work's futures."""
+        if ack:
+            new_entries = 0
+            for slot, taken in ctx["appends"].items():
+                if committed[slot]:
+                    for pend, start, n in taken:
+                        new_entries += n
+                        if not pend.future.done():
+                            pend.future.set_result(int(base[slot]) + start)
+            for slot, taken_off in ctx["offsets"].items():
+                if committed[slot]:
+                    for pend in taken_off:
+                        if not pend.future.done():
+                            pend.future.set_result(True)
+            if new_entries:
+                with self._lock:
+                    self.committed_entries += new_entries
+            return
         requeue_a: list[tuple[int, _Pending]] = []
         requeue_o: list[tuple[int, _PendingOffsets]] = []
-        new_entries = 0  # counted locally; resolvers run concurrently
         for slot, taken in ctx["appends"].items():
             if committed[slot]:
-                for pend, start, n in taken:
-                    new_entries += n
+                continue  # released by the ack phase after standby acks
+            # Distinguish permanent backpressure (log full) from a
+            # transient quorum outage. Only index-less deployments
+            # (no store, or a store the drain cannot trim against)
+            # can fill permanently: the write phase needs a full
+            # max_batch window past the leader's log end and nothing
+            # is ever trimmed, so base + B > slots means no retry can
+            # ever fit. With a log index the drain raises trim and
+            # retries commit.
+            full = (
+                self.log_index is None
+                and base[slot] + self.cfg.max_batch > self.cfg.slots
+                and base[slot] > 0
+            )
+            for pend, _, _ in taken:
+                pend.rounds_left -= 1
+                if full:
+                    if not pend.future.done():  # caller may cancel()
+                        pend.future.set_exception(
+                            PartitionFullError(
+                                f"partition {slot}: log full "
+                                f"({base[slot]}/{self.cfg.slots} used)"
+                            )
+                        )
+                elif pend.rounds_left <= 0:
                     if not pend.future.done():
-                        pend.future.set_result(int(base[slot]) + start)
-            else:
-                # Distinguish permanent backpressure (log full) from a
-                # transient quorum outage. Only index-less deployments
-                # (no store, or a store the drain cannot trim against)
-                # can fill permanently: the write phase needs a full
-                # max_batch window past the leader's log end and nothing
-                # is ever trimmed, so base + B > slots means no retry can
-                # ever fit. With a log index the drain raises trim and
-                # retries commit.
-                full = (
-                    self.log_index is None
-                    and base[slot] + self.cfg.max_batch > self.cfg.slots
-                    and base[slot] > 0
-                )
-                for pend, _, _ in taken:
-                    pend.rounds_left -= 1
-                    if full:
-                        if not pend.future.done():  # caller may cancel()
-                            pend.future.set_exception(
-                                PartitionFullError(
-                                    f"partition {slot}: log full "
-                                    f"({base[slot]}/{self.cfg.slots} used)"
-                                )
+                        pend.future.set_exception(
+                            NotCommittedError(
+                                f"partition {slot}: no quorum after "
+                                f"{self.max_retry_rounds} rounds"
                             )
-                    elif pend.rounds_left <= 0:
-                        if not pend.future.done():
-                            pend.future.set_exception(
-                                NotCommittedError(
-                                    f"partition {slot}: no quorum after "
-                                    f"{self.max_retry_rounds} rounds"
-                                )
-                            )
-                    else:
-                        requeue_a.append((slot, pend))
+                        )
+                else:
+                    requeue_a.append((slot, pend))
         # Failed boundary-pad rounds (empty taken) must still charge the
         # blocked queue head's retry budget: the head is what forced the
         # pad, and without this a quorum outage at the ring boundary would
@@ -1768,14 +2050,14 @@ class DataPlane:
         if pad_failures:
             with self._lock:
                 for slot in pad_failures:
-                    queue = self._appends.get(slot)
-                    if not queue:
+                    q = self._appends.get(slot)
+                    if not q:
                         continue
-                    head = queue[0]
+                    head = q[0]
                     head.rounds_left -= 1
                     if head.rounds_left <= 0:
-                        queue.pop(0)
-                        if not queue:
+                        q.pop(0)
+                        if not q:
                             self._appends.pop(slot, None)
                         if not head.future.done():  # caller may cancel()
                             head.future.set_exception(
@@ -1787,24 +2069,18 @@ class DataPlane:
                             )
         for slot, taken_off in ctx["offsets"].items():
             if committed[slot]:
-                for pend in taken_off:
-                    if not pend.future.done():
-                        pend.future.set_result(True)
-            else:
-                for pend in taken_off:
-                    pend.rounds_left -= 1
-                    if pend.rounds_left <= 0:
-                        if not pend.future.done():  # caller may cancel()
-                            pend.future.set_exception(
-                                NotCommittedError(
-                                    f"partition {slot}: no quorum"
-                                )
+                continue  # released by the ack phase after standby acks
+            for pend in taken_off:
+                pend.rounds_left -= 1
+                if pend.rounds_left <= 0:
+                    if not pend.future.done():  # caller may cancel()
+                        pend.future.set_exception(
+                            NotCommittedError(
+                                f"partition {slot}: no quorum"
                             )
-                    else:
-                        requeue_o.append((slot, pend))
-        if new_entries:
-            with self._lock:
-                self.committed_entries += new_entries
+                        )
+                else:
+                    requeue_o.append((slot, pend))
         if requeue_a or requeue_o:
             with self._lock:
                 for slot, pend in reversed(requeue_a):

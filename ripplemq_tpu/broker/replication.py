@@ -70,6 +70,30 @@ class ReplicationError(NotCommittedError):
 _CATCHUP_BATCH_RECORDS = 256
 _CATCHUP_BATCH_BYTES = 1 << 20
 
+# Sender group-commit caps: one repl.rounds RPC carries the sender's
+# whole queued backlog up to these bounds (well under the 64 MB frame
+# cap). Each queued round pays one sequential RPC otherwise, and under
+# load the per-RPC latency — not bandwidth — becomes the replication
+# stream's capacity (measured: the settle pipeline queuing behind
+# ~10 rounds/s/sender while each RPC idled in standby scheduling).
+_GROUP_COMMIT_BYTES = 8 << 20
+_GROUP_COMMIT_ROUNDS = 128
+
+
+class ReplicationTicket:
+    """One round's in-flight replication: the per-member ack futures of a
+    `RoundReplicator.begin()` plus the begin timestamp the ack-timeout
+    counts from. Opaque to callers — pass it back to `wait()`."""
+
+    __slots__ = ("records", "senders", "futs", "start")
+
+    def __init__(self, records: list, senders: dict, futs: dict,
+                 start: float) -> None:
+        self.records = records
+        self.senders = senders
+        self.futs = futs
+        self.start = start
+
 
 class _Sender(threading.Thread):
     """Ordered record stream to one standby broker."""
@@ -163,14 +187,37 @@ class _Sender(threading.Thread):
                     self._cond.wait(timeout=0.2)
                 if self._stopped:
                     return
-                records, fut = self._queue.pop(0)
+                # GROUP COMMIT: take the whole queued backlog (bounded)
+                # into ONE epoch-stamped RPC — order within the frame is
+                # queue order, so the standby applies the same record
+                # stream, just in fewer round trips. All grouped rounds
+                # ack (or fail) together; a retry re-sends the whole
+                # group, which replay's later-record-wins absorbs
+                # exactly like any duplicated round.
+                group = [self._queue.pop(0)]
+                nbytes = sum(len(r[3]) for r in group[0][0])
+                while (self._queue and len(group) < _GROUP_COMMIT_ROUNDS
+                       and nbytes < _GROUP_COMMIT_BYTES):
+                    recs, _ = self._queue[0]
+                    nbytes += sum(len(r[3]) for r in recs)
+                    group.append(self._queue.pop(0))
+            records = [r for recs, _ in group for r in recs]
+            futs = [f for _, f in group]
+
+            def settle_all(result) -> None:
+                for f in futs:
+                    if not f.done():
+                        if isinstance(result, BaseException):
+                            f.set_exception(result)
+                        else:
+                            f.set_result(result)
+
             while True:
                 if self._stopped:
-                    if not fut.done():
-                        fut.set_exception(ReplicationError("sender stopped"))
+                    settle_all(ReplicationError("sender stopped"))
                     break
                 if not self._rep.active():
-                    fut.set_exception(
+                    settle_all(
                         FencedError("controller deposed (local metadata)")
                     )
                     break
@@ -186,7 +233,7 @@ class _Sender(threading.Thread):
                 if not self._rep.active():
                     # Deposed between the check and the stamp: the epoch
                     # read may be the successor's. Refuse the round.
-                    fut.set_exception(
+                    settle_all(
                         FencedError("controller deposed (local metadata)")
                     )
                     break
@@ -211,12 +258,13 @@ class _Sender(threading.Thread):
                 failures = 0
                 self.unreachable = False
                 if resp.get("ok"):
-                    log.debug("standby %d acked %d records at epoch %d",
-                              self.broker_id, len(records), epoch)
-                    fut.set_result(True)
+                    log.debug("standby %d acked %d records (%d rounds) at "
+                              "epoch %d", self.broker_id, len(records),
+                              len(futs), epoch)
+                    settle_all(True)
                     break
                 if resp.get("error") == "stale_epoch":
-                    fut.set_exception(FencedError("standby reports newer epoch"))
+                    settle_all(FencedError("standby reports newer epoch"))
                     break
                 # Transient standby-side refusal (e.g. it believes itself
                 # the active controller until its fence duty runs): retry.
@@ -308,17 +356,18 @@ class RoundReplicator:
         for s in senders:
             s.stop()
 
-    # -- hot path (DataPlane resolver thread) --
+    # -- hot path (DataPlane resolver/settle threads) --
 
-    def replicate(self, records: list,
-                  timeout_s: Optional[float] = None) -> None:
-        """Block until every current-set member acked this round. Raises
-        FencedError if deposed. A member removed from the set mid-wait is
-        skipped; an unreachable member is flagged suspect (duty loop
-        proposes removal) while the wait continues. `timeout_s` bounds
-        the whole wait (the resolver passes None — a settled round MUST
-        have every member's ack; the linearizable-read barrier passes a
-        bound, since an unconfirmable read should refuse, not hang)."""
+    def begin(self, records: list) -> "ReplicationTicket":
+        """Enqueue one round's records on every current-set member's
+        ordered stream WITHOUT waiting for acks. Returns the ticket
+        `wait()` later blocks on — the two halves of `replicate()`, split
+        so the DataPlane's pipelined settle can keep a window of rounds
+        streaming to the standbys while the device advances (acks are
+        then released strictly in round order by `wait`ing the tickets
+        in order; see broker/dataplane.py settle pipeline). Raises
+        FencedError if deposed, ReplicationError on the empty-set
+        refusal — both BEFORE anything is enqueued."""
         if not self.active():
             raise FencedError("controller deposed (local metadata)")
         targets = set(self.members_fn())
@@ -344,7 +393,29 @@ class RoundReplicator:
             targets |= self._joining
         senders = {bid: self._sender(bid) for bid in targets}
         futs = {bid: s.enqueue(records) for bid, s in senders.items()}
-        start = time.monotonic()
+        return ReplicationTicket(records, senders, futs, time.monotonic())
+
+    def replicate(self, records: list,
+                  timeout_s: Optional[float] = None) -> None:
+        """Block until every current-set member acked this round. Raises
+        FencedError if deposed. A member removed from the set mid-wait is
+        skipped; an unreachable member is flagged suspect (duty loop
+        proposes removal) while the wait continues. `timeout_s` bounds
+        the whole wait (a settled round MUST have every member's ack, so
+        round settling passes None; the linearizable-read barrier passes
+        a bound, since an unconfirmable read should refuse, not hang)."""
+        self.wait(self.begin(records), timeout_s=timeout_s)
+
+    def wait(self, ticket: "ReplicationTicket",
+             timeout_s: Optional[float] = None) -> None:
+        """Second half of replicate(): block until every member acked the
+        ticket's round, with the full waiver/fence discipline (see
+        replicate). The ack deadline counts from begin() — queue time on
+        a stalled stream charges the suspect timer exactly as before."""
+        records = ticket.records
+        senders = ticket.senders
+        futs = ticket.futs
+        start = ticket.start
         acked: list[int] = []
         waived: list[int] = []
         for bid, fut in futs.items():

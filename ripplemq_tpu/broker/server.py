@@ -284,13 +284,14 @@ class BrokerServer:
         self.dataplane: Optional[DataPlane] = None
         self._owns_dataplane = False
         self._replicator = None
+        self._warm_thread: Optional[threading.Thread] = None
         self._catchup_thread: Optional[threading.Thread] = None
         self._boot_failures = 0     # consecutive data-plane boot failures
         if dataplane is not None:
             self.dataplane = dataplane
             self.manager.attach_dataplane(dataplane)
             if dataplane.replicate_fn is None and self._round_store is not None:
-                dataplane.replicate_fn = self._make_replicator().replicate
+                self._wire_replicator(dataplane)
         # No construction-time boot when this broker's (possibly
         # RECOVERED) metadata names it controller: recovered metadata can
         # be arbitrarily stale — a broker restarting after a controller
@@ -382,11 +383,12 @@ class BrokerServer:
                 coalesce_s=self.config.coalesce_s,
                 chain_depth=self.config.chain_depth,
                 pipeline_depth=self.config.pipeline_depth,
+                read_coalesce_s=self.config.read_coalesce_s,
             )
             if image is not None:
                 dp.install(image)
             if self._round_store is not None:
-                dp.replicate_fn = self._make_replicator().replicate
+                self._wire_replicator(dp)
             self._owns_dataplane = True
             self.dataplane = dp
             self.manager.attach_dataplane(dp)
@@ -430,10 +432,20 @@ class BrokerServer:
         # multi-second XLA compile to live traffic. On TAKEOVER
         # (epoch > 0) the first election pass is the latency-critical
         # device work — let it win the lock race before warming.
-        dp.warm_async(
+        self._warm_thread = dp.warm_async(
             buckets=dp.all_buckets(),
             delay_s=2.0 if self.manager.current_epoch() > 0 else 0.0,
         )
+
+    def _wire_replicator(self, dp: DataPlane) -> None:
+        """Attach a fresh replicator to the plane — the blocking
+        replicate_fn plus its begin/wait split, which the plane's settle
+        pipeline uses to keep a window of rounds streaming to the
+        standbys while the device advances (dataplane settle pipeline)."""
+        rep = self._make_replicator()
+        dp.replicate_fn = rep.replicate
+        dp.replicate_begin_fn = rep.begin
+        dp.replicate_wait_fn = rep.wait
 
     def _make_replicator(self):
         from ripplemq_tpu.broker.replication import RoundReplicator
@@ -621,6 +633,10 @@ class BrokerServer:
                 "mirror_gap_slots": dp.mirror_gap_slots(),
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
+                # Settle-pipeline occupancy (pipelined standby
+                # replication): window width, mean depth at enqueue,
+                # and how often dispatch hit the window's backpressure.
+                "settle": dp.settle_stats(),
                 "partitions": dp.cfg.partitions,
                 # Graceful-degradation surface: partitions whose replica
                 # quorum is lost fast-fail consumes/commits with
@@ -1063,13 +1079,23 @@ class BrokerServer:
         replica = self.manager.replica_slot(key, self.broker_id)
         if replica is None:
             replica = 0  # leader not in replicas: metadata race; read slot 0
-        # Read the offset from the leader's own replica slot too: replica
-        # 0 may be masked dead and hold a stale offset table (commits only
-        # apply on acking replicas).
-        offset = self._engine_read_offset(slot, cslot, replica)
+        if req.get("offset") is not None:
+            # Explicit read position (the consumer SDK's prefetch
+            # pipeline): skips the committed-offset lookup; the read is
+            # still leadership-checked and settled-horizon-clamped, and
+            # the committed offset only moves on offset.commit.
+            offset = int(req["offset"])
+            if offset < 0:
+                return {"ok": False, "error": "bad_request: negative offset"}
+        else:
+            # Read the offset from the leader's own replica slot too:
+            # replica 0 may be masked dead and hold a stale offset table
+            # (commits only apply on acking replicas).
+            offset = self._engine_read_offset(slot, cslot, replica)
         limit = req.get("max_messages")
         msgs, next_offset = self._engine_read(
-            slot, offset, replica, None if limit is None else int(limit)
+            slot, offset, replica, None if limit is None else int(limit),
+            wait_s=float(req.get("wait_s", 0) or 0),
         )
         # Offsets are storage offsets (rounds are alignment-padded), so the
         # committable position is next_offset — NOT offset + len(messages).
@@ -1205,15 +1231,48 @@ class BrokerServer:
             return
         rep.replicate([], timeout_s=min(2.0, self.config.rpc_timeout_s))
 
+    # Long-poll ceiling: a waiting consume parks one RPC worker, so the
+    # server-side wait is clipped well below any client RPC timeout (and
+    # the worker pool size bounds how many can park at once).
+    _LONG_POLL_CAP_S = 10.0
+
     def _engine_read(self, slot: int, offset: int, replica: int,
-                     max_msgs: Optional[int] = None):
+                     max_msgs: Optional[int] = None,
+                     wait_s: float = 0.0):
         dp = self._local_engine()
         if dp is not None:
             self._read_barrier()
-            return dp.read(slot, offset, replica, max_msgs)
+            msgs, end = dp.read(slot, offset, replica, max_msgs)
+            if msgs or wait_s <= 0:
+                return msgs, end
+            # Long-poll: an empty fetch parks here until rows settle
+            # past `offset` or the window lapses, so a tail consumer
+            # costs one RPC per DELIVERY instead of one per poll. The
+            # re-read fires off the settled-horizon watermark — a
+            # host-RAM check per tick, no device dispatch (the barrier
+            # above stays valid: rows arriving during the wait are
+            # NEWER than the proof, never staler).
+            deadline = time.monotonic() + min(wait_s, self._LONG_POLL_CAP_S)
+            while time.monotonic() < deadline:
+                if self._stop.wait(timeout=0.01):
+                    break
+                if self._local_engine() is not dp:
+                    break  # deposed mid-wait: refuse via the normal path
+                # LOCK-FREE probe: an aligned int64 element read; a
+                # stale value only delays one tick, and dozens of
+                # parked consumers must not hammer the control lock
+                # the drain and settle threads live under.
+                if int(dp._settled_end[slot]) > offset:
+                    msgs, end = dp.read(slot, offset, replica, max_msgs)
+                    if msgs:
+                        break
+            return msgs, end
         resp = self._engine_call(
             {"type": "engine.read", "slot": slot, "offset": offset,
-             "replica": replica, "max_msgs": max_msgs}
+             "replica": replica, "max_msgs": max_msgs,
+             # The forwarded wait must finish inside the engine-call RPC
+             # timeout or the long poll would read as a dead controller.
+             "wait_s": min(wait_s, max(0.0, self.config.rpc_timeout_s - 1))}
         )
         return list(resp["messages"]), int(resp["end"])
 
@@ -1251,11 +1310,11 @@ class BrokerServer:
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
         if t == "engine.read":
-            self._read_barrier()
             limit = req.get("max_msgs")
-            msgs, end = dp.read(
+            msgs, end = self._engine_read(
                 int(req["slot"]), int(req["offset"]), int(req["replica"]),
                 None if limit is None else int(limit),
+                wait_s=float(req.get("wait_s", 0) or 0),
             )
             return {"ok": True, "messages": msgs, "end": end}
         if t == "engine.read_offset":
@@ -1293,11 +1352,22 @@ class BrokerServer:
         store = self._round_store
         if store is None:
             return {"ok": False, "error": "no_store"}
-        for rec_type, slot, base, payload in req["records"]:
-            store.append(int(rec_type), int(slot), int(base), payload)
+        recs = [(int(t), int(s), int(b), p) for t, s, b, p in req["records"]]
+        append_many = getattr(store, "append_many", None)
+        if append_many is not None:
+            append_many(recs)  # one batched write per frame (group commit)
+        else:
+            for rec in recs:
+                store.append(*rec)
         now = time.monotonic()
         if now - self._repl_last_flush >= 0.05:
-            store.flush()
+            # Deferred fsync (SegmentStore.flush_async): the ack this
+            # handler returns gates the controller's settle pipeline, so
+            # it must not wait out the filesystem's fsync latency. The
+            # promoted-standby boot path still runs its OWN synchronous
+            # flush barrier before the replay scan (_boot_dataplane).
+            flush = getattr(store, "flush_async", store.flush)
+            flush()
             self._repl_last_flush = now
         return {"ok": True}
 

@@ -7,10 +7,26 @@ consume() picks ONE partition round-robin, reads up to max_messages
 hardwired behavior, commit at `:103-109`) immediately commits
 offset + n — at-most-once delivery. auto_commit=False flips to
 at-least-once: process, then call commit() yourself.
+
+Pipelined readahead (`prefetch` > 0, needs a pipelining transport):
+after each delivery the NEXT window's fetch is already in flight at an
+explicit offset (the broker accepts `offset` in consume requests), so a
+drain pays one round-trip of latency total instead of one per window,
+and auto-commits ride the same request-id pipeline asynchronously
+instead of blocking a quorum round per window. `long_poll_s` > 0 makes
+empty fetches park broker-side until rows settle (tail consumers cost
+one RPC per delivery, not one per poll). Both levers are opt-in and
+independently A/B-able against the legacy one-RPC-per-call behavior.
+Note the contract shift when prefetch is on: commits are acknowledged
+ASYNCHRONOUSLY (flushed on close()/flush_commits()), so delivery runs
+ahead of the committed offset — a crash between delivery and commit
+flush re-delivers, i.e. prefetch trades the strict at-most-once
+auto-commit for at-least-once pipelining.
 """
 
 from __future__ import annotations
 
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
@@ -40,6 +56,8 @@ class ConsumerClient:
         retry_backoff_s: float = 0.2,
         deadline_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        prefetch: int = 0,
+        long_poll_s: float = 0.0,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -47,7 +65,14 @@ class ConsumerClient:
         self.consumer_id = consumer_id
         self.auto_commit = auto_commit
         self.max_messages = max_messages
+        self.prefetch = max(0, int(prefetch))
+        self.long_poll_s = max(0.0, float(long_poll_s))
         self._timeout = rpc_timeout_s
+        # Per-(topic, partition) readahead state: the in-flight fetch at
+        # an explicit offset, and the newest async auto-commit (kept so
+        # errors surface and close() can flush).
+        self._pf: dict[tuple[str, int], dict] = {}
+        self._commits: dict[tuple[str, int], tuple[int, object, str]] = {}
         # Unified retry discipline (wire/retry.py): jittered exponential
         # backoff, optional per-operation deadline budget.
         self._retry = retry_policy or RetryPolicy(
@@ -86,6 +111,21 @@ class ConsumerClient:
         STORAGE offsets (the broker pads replication rounds for the TPU's
         alignment), so `offset + len(messages)` is NOT a valid position."""
         limit = self.max_messages if max_messages is None else max_messages
+        call_async = getattr(self._transport, "call_async", None)
+        if self.prefetch > 0 and call_async is not None:
+            # Pin the round-robin choice ONCE per call: the prefetch
+            # probe and the sync fallback below each advancing the
+            # stateful selector would desynchronize armed readahead
+            # state from delivered partitions (with an even partition
+            # count the two paths alternate in lockstep and some
+            # partitions are never consumed at all).
+            if partition is None:
+                t = self._meta.topic(topic)
+                if t is not None:
+                    partition = self._selector.select(t)
+            got = self._consume_prefetched(topic, partition, limit, call_async)
+            if got is not None:
+                return got
         run = self._retry.begin()
         while run.attempt():
             t = self._meta.topic(topic)
@@ -99,6 +139,9 @@ class ConsumerClient:
                 run.note(f"no leader known for {topic}[{pid}]")
                 self._refresh_quietly()
                 continue
+            # A readahead fallback must not race its own unflushed
+            # commits: the server-tracked offset lags until they apply.
+            self._flush_commit_key(topic, pid)
             try:
                 resp = self._transport.call(
                     addr,
@@ -114,9 +157,8 @@ class ConsumerClient:
                 msgs = list(resp["messages"])
                 offset = int(resp["offset"])
                 next_offset = int(resp.get("next_offset", offset))
-                if msgs and self.auto_commit:
-                    self.commit(topic, pid, next_offset)
-                return msgs, pid, offset, next_offset
+                return self._deliver(topic, pid, addr, limit, call_async,
+                                     msgs, offset, next_offset)
             err = str(resp.get("error", ""))
             run.note(err)
             if err == "not_leader":
@@ -125,6 +167,119 @@ class ConsumerClient:
             if fatal_response_error(err):
                 raise ConsumeError(err)
         raise ConsumeError(f"consume from {topic} failed: {run.summary()}")
+
+    # ------------------------------------------------- prefetch pipeline
+
+    def _consume_prefetched(self, topic: str, partition: Optional[int],
+                            limit: int, call_async):
+        """Serve one consume from the in-flight readahead fetch, if one
+        is armed and healthy. Returns None to fall back to the sync
+        path (which re-resolves leadership with the retry policy). The
+        caller pins `partition` before calling (one selector advance
+        per consume)."""
+        if partition is None:
+            return None  # topic unknown: the sync path resolves it
+        pid = partition
+        st = self._pf.pop((topic, pid), None)
+        if st is None or st["limit"] != limit:
+            return None
+        try:
+            resp = st["fut"].result(
+                timeout=self._timeout + st.get("wait_s", 0.0)
+            )
+        except (TimeoutError, FuturesTimeoutError, RpcError, OSError):
+            return None  # pipeline broken: sync path re-resolves
+        if not resp.get("ok"):
+            return None  # not_leader/refusal: sync path handles + retries
+        msgs = list(resp["messages"])
+        offset = st["offset"]
+        next_offset = int(resp.get("next_offset", offset))
+        return self._deliver(topic, pid, st["addr"], limit, call_async,
+                             msgs, offset, next_offset)
+
+    def _deliver(self, topic: str, pid: int, addr: str, limit: int,
+                 call_async, msgs: list, offset: int, next_offset: int):
+        """Common delivery tail: arm the next readahead fetch, run the
+        auto-commit (async when prefetching), return the position tuple."""
+        if self.prefetch > 0 and call_async is not None:
+            # Re-arm at next_offset. After an EMPTY window only a
+            # long-polling fetch is worth keeping in flight (a plain one
+            # would answer empty again immediately; drains break on
+            # empty anyway).
+            if msgs or self.long_poll_s > 0:
+                req = {"type": "consume", "topic": topic, "partition": pid,
+                       "consumer": self.consumer_id, "max_messages": limit,
+                       "offset": int(next_offset)}
+                wait_s = self.long_poll_s if not msgs else 0.0
+                if wait_s > 0:
+                    req["wait_s"] = wait_s
+                try:
+                    fut = call_async(addr, req)
+                    self._pf[(topic, pid)] = {
+                        "offset": int(next_offset), "fut": fut,
+                        "addr": addr, "limit": limit, "wait_s": wait_s,
+                    }
+                except RpcError:
+                    pass  # connection hiccup: next call goes sync
+        if msgs and self.auto_commit:
+            self._auto_commit(topic, pid, next_offset, addr, call_async)
+        return msgs, pid, offset, next_offset
+
+    def _auto_commit(self, topic: str, pid: int, offset: int, addr: str,
+                     call_async) -> None:
+        if self.prefetch <= 0 or call_async is None:
+            self.commit(topic, pid, offset)  # strict: ack before deliver
+            return
+        # Pipelined commit: offsets are monotonically increasing per
+        # (consumer, partition) and ride ONE ordered connection, so a
+        # newer in-flight commit supersedes an older one; only the
+        # newest needs tracking. A commit that FAILED is re-driven
+        # synchronously (with retries) before anything newer is sent —
+        # errors must not silently drop the committed position.
+        key = (topic, pid)
+        prev = self._commits.get(key)
+        if prev is not None and prev[1].done():
+            self._commits.pop(key, None)
+            if not self._commit_ok(prev[1]):
+                self.commit(topic, pid, max(int(prev[0]), int(offset)))
+                return
+        try:
+            fut = call_async(addr, {
+                "type": "offset.commit", "topic": topic, "partition": pid,
+                "consumer": self.consumer_id, "offset": int(offset),
+            })
+        except RpcError:
+            self.commit(topic, pid, offset)  # sync fallback w/ retries
+            return
+        self._commits[key] = (int(offset), fut, addr)
+
+    @staticmethod
+    def _commit_ok(fut) -> bool:
+        try:
+            return bool(fut.result(timeout=0).get("ok"))
+        except Exception:
+            return False
+
+    def _flush_commit_key(self, topic: str, pid: int) -> None:
+        entry = self._commits.pop((topic, pid), None)
+        if entry is None:
+            return
+        off, fut, _ = entry
+        try:
+            ok = bool(fut.result(timeout=self._timeout).get("ok"))
+        except Exception:
+            ok = False
+        if not ok:
+            self.commit(topic, pid, off)
+
+    def flush_commits(self) -> None:
+        """Drain every in-flight async auto-commit (prefetch mode),
+        re-driving failures through the sync commit path. Called by
+        close(); call it directly at consumer-group checkpoints."""
+        for (topic, pid) in list(self._commits):
+            self._flush_commit_key(topic, pid)
+
+    # ------------------------------------------------------------- commits
 
     def commit(self, topic: str, partition: int, offset: int) -> None:
         """Commit an absolute offset (replicated through the partition's
@@ -163,6 +318,10 @@ class ConsumerClient:
         )
 
     def close(self) -> None:
+        try:
+            self.flush_commits()
+        except Exception:
+            pass  # best-effort: close must not raise over a dead broker
         self._meta.close()
         if self._owns_transport:
             self._transport.close()

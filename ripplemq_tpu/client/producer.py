@@ -111,16 +111,17 @@ class ProducerClient:
                             partition: Optional[int] = None):
         """Pipelined produce: returns a waiter `() -> int` (first
         assigned offset). Many batches can be in flight per connection —
-        the TcpClient pipelines frames by request id — so one producer
-        thread can keep a whole window of rounds in the broker's batcher
-        (the reference's client is strictly one sync RPC at a time,
-        PartitionClient.java:31-59). No retry/refresh logic on this
-        path: the waiter raises ProduceError on any failure and the
-        caller decides (a windowed sender usually just re-sends)."""
+        frames carry request ids, so an in-flight batch costs one
+        pending future, never a thread (the in-proc transport serves the
+        same `call_async` surface with an inline-resolved future; no
+        transport wraps a sync call in a pool thread). The waiter
+        follows ONE not_leader hint with a pipelined re-send; any other
+        failure raises ProduceError and the caller decides (a windowed
+        sender usually just re-sends)."""
         if not messages:
             raise ValueError("empty batch")
         call_async = getattr(self._transport, "call_async", None)
-        if call_async is None:
+        if call_async is None:  # exotic custom transport: stay sync
             resp_val = self.produce_batch(topic, messages,
                                           partition=partition)
             return lambda: resp_val
@@ -131,14 +132,24 @@ class ProducerClient:
         addr = self._meta.leader_addr(topic, pid)
         if addr is None:
             raise ProduceError(f"no leader known for {topic}[{pid}]")
-        fut = call_async(
-            addr,
-            {"type": "produce", "topic": topic, "partition": pid,
-             "messages": list(messages)},
-        )
+        req = {"type": "produce", "topic": topic, "partition": pid,
+               "messages": list(messages)}
+        fut = call_async(addr, req)
 
         def wait() -> int:
             resp = fut.result(timeout=self._timeout)
+            if not resp.get("ok") and resp.get("error") == "not_leader":
+                # Leadership moved under the window: one pipelined
+                # re-send at the hinted leader (refresh so later
+                # batches route straight there).
+                self._refresh_quietly()
+                addr2 = resp.get("leader_addr") or self._meta.leader_addr(
+                    topic, pid
+                )
+                if addr2:
+                    resp = call_async(addr2, req).result(
+                        timeout=self._timeout
+                    )
             if not resp.get("ok"):
                 raise ProduceError(str(resp.get("error", "produce failed")))
             return int(resp["base_offset"])

@@ -105,10 +105,21 @@ class EngineConfig:
     packed_writes: bool = False  # clip append DMA windows to the round's
     #                              payload extent instead of always moving
     #                              the full [B, SB] block
+    # Host-path knob (NOT a device shape — no recompile): how many
+    # dispatched rounds may have their standby replication in flight
+    # while the device advances. Acks and the settled-read horizon are
+    # released strictly in round order; the window backpressures when
+    # full and drains on any fencing/deposition/membership event, so the
+    # chaos plane's handover invariants hold verbatim at any width
+    # (broker/dataplane.py settle pipeline). 1 = legacy serialized
+    # settle (each round's acks land before the next round's release).
+    settle_window: int = 4
 
     def __post_init__(self) -> None:
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1")
+        if self.settle_window < 1:
+            raise ValueError("settle_window must be >= 1")
         if self.max_batch > self.slots:
             raise ValueError("max_batch cannot exceed slots")
         if self.read_batch > self.slots:

@@ -75,6 +75,10 @@ class ClusterConfig:
     coalesce_s: float = 0.002
     chain_depth: int = 4
     pipeline_depth: int = 8
+    # Read-side assembly window before each batched device-read dispatch
+    # (DataPlane.read_coalesce_s — the consume-side mirror of
+    # coalesce_s); 0 disables.
+    read_coalesce_s: float = 0.001
     # Linearizable reads (off by default — the reference serves
     # leader-local reads with no bound at all,
     # PartitionStateMachine.java:85-110, and the default here is already
@@ -212,6 +216,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
     if "coalesce_s" in raw:
         extra["coalesce_s"] = float(raw["coalesce_s"])
+    if "read_coalesce_s" in raw:
+        extra["read_coalesce_s"] = float(raw["read_coalesce_s"])
     if "chain_depth" in raw:
         extra["chain_depth"] = int(raw["chain_depth"])
     if "pipeline_depth" in raw:

@@ -215,6 +215,20 @@ class InProcClient(Transport):
     def call(self, addr: str, request: dict, timeout: float = 3.0) -> dict:
         return self._net.deliver(self.src_addr, addr, request, timeout)
 
+    def call_async(self, addr: str, request: dict) -> Future:
+        """Uniform pipelining surface: the in-proc network is
+        synchronous BY DESIGN (deterministic interleavings), so this
+        executes inline and returns an already-resolved future. Callers
+        written against the async surface — windowed producers, the
+        consumer readahead — then run unchanged on in-proc clusters
+        without anyone burning a pool thread around a sync call."""
+        fut: Future = Future()
+        try:
+            fut.set_result(self.call(addr, request))
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
 
 # ---------------------------------------------------------------------------
 # TCP

@@ -32,7 +32,12 @@ PHASES = 2
 def test_fixed_seed_chaos_smoke(seed):
     from ripplemq_tpu.chaos import run_chaos
 
-    verdict = run_chaos(seed=seed, phases=PHASES, phase_s=0.5)
+    # Convergence is a LIVENESS probe with a wall-clock deadline: on a
+    # contended tier-1 host (hypervisor throttling phases measured >2x)
+    # the default 30 s can flake while safety stays clean — give the
+    # probe headroom; the safety checker's verdict is what gates.
+    verdict = run_chaos(seed=seed, phases=PHASES, phase_s=0.5,
+                        converge_timeout_s=90.0)
     assert verdict["violations"] == [], (
         f"seed {seed} safety violations: {verdict['violations']}\n"
         f"trace: {trace_json(verdict['trace'])}"

@@ -58,6 +58,7 @@ FAST_MODULES = {
     "test_read_batching",
     "test_read_cache",
     "test_readme_bench",
+    "test_settle_pipeline",
     "test_retention",
     "test_retry_policy",
     "test_rs",
