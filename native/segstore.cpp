@@ -14,7 +14,10 @@
 //   u32 slot    (partition slot; 0 for meta)
 //   u32 base    (first storage offset of the round; count for offsets)
 //   u32 len     (payload byte length)
-//   u32 crc32   (CRC-32 of payload, zlib polynomial)
+//   u32 crc32   (CRC-32 of the 17 header bytes above + payload, zlib
+//               polynomial — header fields are covered so a flipped
+//               slot/base/type/len bit fails verification like payload
+//               rot instead of replaying rows at the wrong place)
 //   u8  payload[len]
 //
 // Segments rotate at a size threshold: segment-%08d.log in the store dir.
@@ -59,6 +62,16 @@ void crc_init() {
 uint32_t crc32_of(const uint8_t* data, size_t len) {
   crc_init();
   uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Frame CRC: the 17 header bytes before the crc field chained with the
+// payload (equals Python's zlib.crc32(payload, zlib.crc32(header17))).
+uint32_t frame_crc(const uint8_t* hdr17, const uint8_t* data, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < 17; i++) c = crc_table[(c ^ hdr17[i]) & 0xFF] ^ (c >> 8);
   for (size_t i = 0; i < len; i++) c = crc_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
 }
@@ -182,7 +195,7 @@ int segstore_append_at(void* h, int type, int slot, int base,
   put_u32(&frame[5], (uint32_t)slot);
   put_u32(&frame[9], (uint32_t)base);
   put_u32(&frame[13], (uint32_t)len);
-  put_u32(&frame[17], crc32_of(data, (size_t)len));
+  put_u32(&frame[17], frame_crc(frame.data(), data, (size_t)len));
   if (len) memcpy(&frame[kHeader], data, (size_t)len);
   if (out_seg) *out_seg = s->seg_index;
   if (out_off) *out_off = s->seg_size + (long)kHeader;
@@ -314,7 +327,7 @@ int segscan_next_at(void* h, int* type, int* slot, int* base,
     }
     long pos_after_header = ftell(sc->f);
     got = len ? fread(buf, 1, len, sc->f) : 0;
-    if (got < len || crc32_of(buf, len) != crc) {
+    if (got < len || frame_crc(hdr, buf, len) != crc) {
       fclose(sc->f);
       sc->f = nullptr;
       if (last_file) {

@@ -5,6 +5,9 @@
     python profiles/chaos_soak.py --seed 7 --phases 6 --phase-s 1.0
     python profiles/chaos_soak.py --sweep 10           # seeds 0..9
     python profiles/chaos_soak.py --replay trace.json  # re-apply a trace
+    python profiles/chaos_soak.py --backend proc --seed 3
+        # real broker subprocesses over TCP: SIGKILL + disk-fault
+        # schedules (torn tail / bit flip / lost sealed segment)
 
 Every run prints ONE JSON document: seed, the applied fault trace, its
 sha256 digest (byte-for-byte reproducible from the seed — re-running
@@ -39,6 +42,13 @@ def main() -> int:
     ap.add_argument("--ops-per-phase", type=int, default=2)
     ap.add_argument("--brokers", type=int, default=3)
     ap.add_argument("--partitions", type=int, default=2)
+    ap.add_argument("--backend", choices=["inproc", "proc"],
+                    default="inproc",
+                    help="'proc' boots real broker subprocesses over TCP "
+                         "and drives SIGKILL + disk-fault schedules "
+                         "(torn tail / bit flip / lost sealed segment) "
+                         "instead of in-proc network faults; identical "
+                         "JSON verdict schema")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON file holding a recorded trace (or a full "
                          "verdict) to re-apply instead of generating "
@@ -61,6 +71,11 @@ def main() -> int:
         with open(args.replay) as f:
             doc = json.load(f)
         trace = doc["trace"] if isinstance(doc, dict) else doc
+        if isinstance(doc, dict) and "backend" in doc:
+            # A recorded verdict names the substrate that produced it;
+            # replaying a proc trace (SIGKILL + disk ops) on the in-proc
+            # backend would silently change what is being reproduced.
+            args.backend = doc["backend"]
         n_phases = 1 + max((t.get("phase", 0) for t in trace), default=0)
         schedule = [[] for _ in range(n_phases)]
         for t in trace:
@@ -80,6 +95,10 @@ def main() -> int:
             phase_s=args.phase_s,
             ops_per_phase=args.ops_per_phase,
             schedule=schedule,
+            backend=args.backend,
+            # Process boots (JAX import + XLA compiles per broker) put
+            # convergence probes on a different clock than in-proc runs.
+            converge_timeout_s=120.0 if args.backend == "proc" else 30.0,
         )
         results.append(v)
     out = results[0] if len(results) == 1 else {

@@ -22,6 +22,7 @@ independent JRaft ballot per group).
 
 from __future__ import annotations
 
+import bisect
 import queue
 import threading
 from concurrent.futures import Future
@@ -152,8 +153,20 @@ class DataPlane:
         host_read_cache: bool = True,
         settle_window: Optional[int] = None,
         read_coalesce_s: float = 0.001,
+        durability: str = "async",
     ) -> None:
         self.cfg = cfg
+        # Durability mode for the settle-path persist: "async" defers
+        # fsync to the store's flusher thread at flush_interval_s cadence
+        # (disk lags acks by at most one interval — the PR 3 contract);
+        # "strict" fsyncs synchronously before every settled round's acks
+        # release, so acked data never lags disk at all (the standby ack
+        # path honors the same knob in broker/server._handle_repl_rounds).
+        if durability not in ("async", "strict"):
+            raise ValueError(
+                f"durability must be 'async' or 'strict', got {durability!r}"
+            )
+        self.durability = durability
         # Durability tier: committed rounds are framed into the segment
         # store from the step thread; fsync happens at most every
         # `flush_interval_s` (0 = every round). "Committed" therefore
@@ -205,6 +218,18 @@ class DataPlane:
         # it is then store-served and never consults the mirror), rather
         # than staying disabled for the slot's lifetime.
         self._mirror_gap: dict[int, list[int]] = {}
+        # Per-slot SETTLED GAPS (the mirror-gap analogue for the read
+        # horizon): sorted disjoint [begin, end) absolute row ranges that
+        # are device-committed but whose standby replication FAILED —
+        # nacked to their producers, so they must stay invisible even
+        # after the slot settles NEWER rounds and `_settled_end` passes
+        # them. Every read path (device ring, host mirror, store) skips
+        # these ranges; promotion/boot replay rebuilds them from the
+        # recovered store's coverage holes (replay_records gaps_out).
+        # Ranges are never re-covered within a controller lifetime
+        # (bases only advance), so entries are permanent until the next
+        # install(); memory is two ints per failed round.
+        self._settled_gaps: dict[int, list[list[int]]] = {}
         # Persisted prefix per partition: rows below this are in the
         # ROUND STORE (appended; flush may lag by flush_interval_s).
         # Advanced by _persist_round only after the store append
@@ -285,6 +310,17 @@ class DataPlane:
 
         self._appends: dict[int, list[_Pending]] = {}
         self._offsets: dict[int, list[_PendingOffsets]] = {}
+        # Consecutive device-uncommitted rounds per slot (reset on any
+        # committed round, and on set_leader — a fresh term is a fresh
+        # chance). A long streak with a LIVE leader is the signature of
+        # the device-term-skew wedge the chaos plane caught (seed 7): an
+        # election bumped the device current_term but its OP_SET_LEADER
+        # advert never stuck, so every round dispatches with a stale
+        # term and is refused forever while the metadata plane sees a
+        # healthy leader and never re-elects. stalled_slots() feeds the
+        # controller duty's needs_elections gate so exactly that state
+        # self-heals by re-election instead of wedging the partition.
+        self._nocommit_streak: dict[int, int] = {}
         self._lock = threading.Lock()          # queues + control tables
         self._device_lock = threading.Lock()   # every touch of self._state
         self._work = threading.Event()
@@ -488,6 +524,10 @@ class DataPlane:
         with self._lock:
             self.leader[slot] = leader_slot
             self.term[slot] = term
+            # A new term is a new chance to commit: clear the slot's
+            # no-commit streak so a just-healed term skew doesn't keep
+            # re-triggering elections before the next round lands.
+            self._nocommit_streak.pop(slot, None)
 
     def set_alive(self, alive: np.ndarray) -> None:
         """Install a new [P, R] per-partition replica liveness mask."""
@@ -523,6 +563,87 @@ class DataPlane:
         heal-time dict mutation)."""
         with self._lock:
             return len(self._mirror_gap)
+
+    def settled_gap_slots(self) -> int:
+        """Count of slots carrying at least one settled gap (device-
+        committed rows whose replication failed; skipped by every read
+        path) — locked like mirror_gap_slots: observability readers must
+        not race the settle thread's dict mutation."""
+        with self._lock:
+            return sum(1 for g in self._settled_gaps.values() if g)
+
+    def settled_end(self, slot: int) -> int:
+        """The slot's settled-read horizon, under the plane's lock (the
+        advisor pattern of mirror_gap_slots: external pollers — the
+        broker's long-poll probe, admin surfaces — must not reach into
+        the array bare)."""
+        with self._lock:
+            return int(self._settled_end[slot])
+
+    def stalled_slots(self, threshold: Optional[int] = None) -> list[int]:
+        """Slots whose last `threshold` dispatched rounds ALL failed to
+        commit on device (default: 2x the per-submit retry budget, so a
+        single submit's worth of transient failures never trips it).
+        This is the liveness probe for the device-term-skew wedge: the
+        controller duty treats a stalled slot as election-worthy even
+        though its leader looks alive, and plan_elections confirms the
+        skew against the device current_term before nominating."""
+        if threshold is None:
+            threshold = 2 * self.max_retry_rounds
+        with self._lock:
+            return sorted(
+                s for s, n in self._nocommit_streak.items()
+                if n >= threshold
+            )
+
+    def reset_stall(self, slot: int) -> None:
+        """Clear the slot's no-commit streak: the election duty's device
+        probe disproved term skew (stalled but term-aligned — an engine-
+        quorum outage elections cannot help). Without this decay, a slot
+        whose traffic stops right after such an outage stays "stalled"
+        forever: stalled_slots() keeps reporting it and every duty tick
+        re-pays the plan_elections device fetch at the election timeout
+        on a healthy idle cluster. Fresh failing dispatches re-build the
+        streak, so a real skew appearing later still trips the probe."""
+        with self._lock:
+            self._nocommit_streak.pop(slot, None)
+
+    def _add_settled_gap_locked(self, slot: int, begin: int,
+                                end: int) -> None:
+        """Record one failed round's [begin, end) as a settled gap
+        (caller holds self._lock). Ranges arrive in base order within a
+        slot (bases only advance), so insertion is an append that merges
+        with an adjacent/overlapping predecessor."""
+        if end <= begin:
+            return
+        gaps = self._settled_gaps.setdefault(slot, [])
+        if gaps and begin <= gaps[-1][1]:
+            gaps[-1][1] = max(gaps[-1][1], end)
+        else:
+            gaps.append([begin, end])
+
+    def _gap_clamp_locked(self, slot: int, offset: int,
+                          count: int) -> tuple[Optional[int], int]:
+        """Clamp one read window against the slot's settled gaps (caller
+        holds self._lock). Returns (skip_to, count): `skip_to` non-None
+        means `offset` sits INSIDE a gap — serve nothing and continue at
+        skip_to (the same contract as alignment padding: nacked rows
+        advance next_offset without delivering); otherwise `count` is
+        clamped so the window stops at the first gap past `offset`."""
+        gaps = self._settled_gaps.get(slot)
+        if not gaps:
+            return None, count
+        # Sorted disjoint ranges: bisect to the candidate at-or-before
+        # `offset` — a flap-heavy controller accumulates gaps for its
+        # whole lifetime and this probe sits on every read path inside
+        # the plane's contended lock, so the common no-gap case must not
+        # walk the history.
+        i = bisect.bisect_right(gaps, offset, key=lambda g: g[0]) - 1
+        if i >= 0 and gaps[i][0] <= offset < gaps[i][1]:
+            return gaps[i][1], 0
+        if i + 1 < len(gaps):
+            return None, min(count, gaps[i + 1][0] - offset)
+        return None, count
 
     def quorum_lost(self, slot: int) -> bool:
         """True iff partition `slot` cannot commit ANY round right now:
@@ -728,6 +849,17 @@ class DataPlane:
         while True:
             with self._lock:
                 trim = int(self.trim[slot])
+                skip_to, _ = self._gap_clamp_locked(slot, offset, 1)
+            if skip_to is not None:
+                # Inside a settled gap (replication-FAILED round): walk
+                # PAST it and keep reading — consumers only advance
+                # their committed offset when a batch delivers messages,
+                # so an empty-but-advanced answer here would strand them
+                # below the gap forever (the same contract as the store
+                # path's jump-forward: nacked rows, like padding, are
+                # crossed inside ONE read call).
+                offset = skip_to
+                continue
             if offset < trim and self.log_index is not None:
                 try:
                     got = self._read_store(slot, offset, max_msgs)
@@ -742,6 +874,15 @@ class DataPlane:
                     time.sleep(0.001)
                     continue
                 if got is not None:
+                    msgs_got, nxt_got = got
+                    if not msgs_got and nxt_got > offset:
+                        # An all-padding store window (a persisted
+                        # boundary-pad round, or a record clamped at a
+                        # gap): keep walking — see the gap comment
+                        # above for why empty-but-advanced must not
+                        # reach the caller while rows remain.
+                        offset = nxt_got
+                        continue
                     return got
                 # Nothing persisted at-or-after `offset` (store GC can
                 # reclaim a partition's entire below-trim history):
@@ -753,6 +894,10 @@ class DataPlane:
                 if res is _CACHE_LAPPED:
                     continue  # trim overran the window mid-copy: store-serve
                 if res is not None:
+                    msgs_res, nxt_res = res
+                    if not msgs_res and nxt_res > offset:
+                        offset = nxt_res  # all-padding window: keep walking
+                        continue
                     self.read_cache_hits += 1
                     return res
             fut: Future = Future()
@@ -767,18 +912,36 @@ class DataPlane:
             # Clamp to the settled horizon: the device's commit index
             # includes rounds whose replication may still fail — those
             # rows are nacked and must stay invisible (see _resolve_one).
+            # Settled GAPS (replication-FAILED rounds the horizon later
+            # passed) are skipped the same way: inside a gap the read
+            # serves nothing and jumps to its end; a window reaching a
+            # gap stops at its begin.
             count = int(count)
             with self._lock:
                 settled_room = max(0, int(self._settled_end[slot]) - offset)
-            if count > settled_room:
-                count = settled_room
+                skip_to, gap_room = self._gap_clamp_locked(
+                    slot, offset, count
+                )
+            if skip_to is not None:
+                offset = skip_to  # raced into a gap recorded mid-read
+                continue
+            count = min(count, settled_room, gap_room)
             with_pos = decode_entries_with_pos(data, lens, count)
             with self._lock:
                 trim_after = int(self.trim[slot])
-            if trim_after <= offset or self.log_index is None:
-                break
-            # trim advanced past this window mid-read: its ring rows may
-            # hold the next lap now — retry (next pass store-serves).
+            if trim_after > offset and self.log_index is not None:
+                # trim advanced past this window mid-read: its ring rows
+                # may hold the next lap now — retry (store-serves next).
+                continue
+            if not with_pos and 0 < count < settled_room:
+                # All-padding window short of the horizon (clamped at a
+                # settled gap, or a boundary-pad round): walk on — an
+                # empty-but-advanced answer must not reach the caller
+                # while settled rows remain above (see the gap comment
+                # at the loop head).
+                offset += count
+                continue
+            break
         count = int(count)
         if max_msgs is not None and len(with_pos) > max(0, max_msgs):
             with_pos = with_pos[: max(0, max_msgs)]
@@ -806,6 +969,9 @@ class DataPlane:
             end = int(self._settled_end[slot])
             cend = int(self._cache_end[slot])
             dirty = slot in self._shadow_dirty
+            skip_to, gap_room = self._gap_clamp_locked(
+                slot, offset, self.cfg.read_batch
+            )
         if dirty:
             # A resolve failed with the slot's round outcome unknown:
             # the log-end shadow may TRAIL device-committed rows until
@@ -814,12 +980,17 @@ class DataPlane:
             # partition. The device path's commit bound is the
             # authority.
             return None
+        if skip_to is not None:
+            # Inside a settled gap (replication-FAILED round): nothing
+            # to serve, continue past it — host-authoritative, same as
+            # the at-horizon empty answer below.
+            return [], skip_to
         if offset >= end:
             return [], offset  # caught up: nothing committed past offset
         if offset >= cend:
             return None  # mirror gap: the device ring is the authority
         pos = offset % S
-        k = min(end - offset, cend - offset, self.cfg.read_batch)
+        k = min(end - offset, cend - offset, self.cfg.read_batch, gap_room)
         if pos + k <= S:
             rows = self._host_ring[slot, pos : pos + k].copy()
         else:  # window spans the ring wrap, same as the device read
@@ -889,6 +1060,15 @@ class DataPlane:
             k = min(nrows - row, self.cfg.read_batch)
             if k <= 0:
                 return None
+            # Settled-gap clamp, store edition: a LOCAL store never holds
+            # gap rows (failed rounds are not persisted here), but a
+            # promoted standby's can, and the trim watermark passing a
+            # gap after a ring wrap must not let the store re-expose
+            # rows every other path refuses.
+            with self._lock:
+                skip_to, k = self._gap_clamp_locked(slot, eff, k)
+            if skip_to is not None:
+                return [], skip_to
             try:
                 data = self.store.read_payload(locator, row * SB, k * SB)
             except FileNotFoundError:
@@ -1763,6 +1943,22 @@ class DataPlane:
                 self._settle_fenced = True
             with self._lock:
                 self.step_errors += 1
+                # Settled-gap recording: every device-committed round of
+                # this entry is now NACKED (its futures fail below) while
+                # its rows sit in the device ring and its range advanced
+                # the log-end shadow. If the slot later settles newer
+                # rounds, `_settled_end` passes this range — the gap is
+                # what keeps every read path from serving it (the two
+                # PR 2 residual windows; see __init__).
+                for k, rc in enumerate(ctx["chain"]):
+                    for slot in rc["appends"]:
+                        n = rc["counts"].get(slot, 0)
+                        if committed[k, slot] and n > 0:
+                            adv = -(-n // ALIGN) * ALIGN
+                            self._add_settled_gap_locked(
+                                slot, rc["bases"][slot],
+                                rc["bases"][slot] + adv,
+                            )
             log.warning("round settle error: %s: %s", type(e).__name__, e)
             self._fail_committed(ctx, committed, e)
         finally:
@@ -1868,6 +2064,14 @@ class DataPlane:
                     for slot, end in ends:
                         if end > self._persisted[slot]:
                             self._persisted[slot] = end
+        if self.durability == "strict":
+            # Strict deployments opt out of the flush_async lag wholesale:
+            # the settle thread fsyncs BEFORE this round's acks release,
+            # so an acked round is on disk on the controller (the standby
+            # ack path flushes synchronously too — server._handle_repl_
+            # rounds) even across a correlated full-cluster kill.
+            self.store.flush()
+            return
         now = time.monotonic()
         if now - self._last_flush >= self.flush_interval_s:
             # Deferred fsync (same durability lag contract — see
@@ -1877,18 +2081,30 @@ class DataPlane:
             flush()
             self._last_flush = now
 
-    def install(self, image: ReplicaState) -> None:
+    def install(self, image: ReplicaState,
+                settled_gaps: Optional[dict[int, list[list[int]]]] = None
+                ) -> None:
         """Install a recovered single-replica image (see recover_image).
         Re-derives the retention tables: the replayed ring holds at most
         the last `slots` rows per partition, so anything below
         `log_end - slots` is store-only (replay writes exactly the rows
         each record carried — no full-window clobber — hence everything
-        ring-resident is intact and servable)."""
+        ring-resident is intact and servable). `settled_gaps` is the
+        recovered store's coverage-hole map (replay_records gaps_out):
+        ranges below the final log end that no record covers — exactly
+        the rounds this store's controller nacked — re-registered so the
+        restarted plane keeps refusing to serve them (without it, a gap
+        inside the final ring window reads back as the PREVIOUS lap's
+        rows at the wrong offsets)."""
         ends = np.asarray(image.log_end, np.int64)
         with self._lock:
             self._log_end = ends.copy()
             self._persisted = ends.copy()  # the image came FROM the store
             self._settled_end = ends.copy()  # store records are settled
+            self._settled_gaps = {
+                int(s): [[int(b), int(e)] for b, e in v]
+                for s, v in (settled_gaps or {}).items() if v
+            }
             if self._host_ring is not None:
                 # Seed the mirror from the replayed image: rows land at
                 # their ring positions during replay, so the first
@@ -1995,6 +2211,19 @@ class DataPlane:
                 with self._lock:
                     self.committed_entries += new_entries
             return
+        # No-commit streak bookkeeping (this resolver pass sees every
+        # dispatched round exactly once): a committed round clears its
+        # slots, an uncommitted one lengthens them — see stalled_slots().
+        touched = set(ctx["appends"]) | set(ctx["offsets"])
+        if touched:
+            with self._lock:
+                for slot in touched:
+                    if committed[slot]:
+                        self._nocommit_streak.pop(slot, None)
+                    else:
+                        self._nocommit_streak[slot] = (
+                            self._nocommit_streak.get(slot, 0) + 1
+                        )
         requeue_a: list[tuple[int, _Pending]] = []
         requeue_o: list[tuple[int, _PendingOffsets]] = []
         for slot, taken in ctx["appends"].items():
@@ -2091,19 +2320,23 @@ class DataPlane:
 
 
 def recover_image(cfg: EngineConfig, store_dir: str,
-                  use_native: Optional[bool] = None) -> Optional[ReplicaState]:
+                  use_native: Optional[bool] = None,
+                  gaps_out: Optional[dict] = None) -> Optional[ReplicaState]:
     """Replay a segment store directory into a single-replica state image,
     healing erasure-protected sealed segments first: a missing/corrupt
     sealed segment is rebuilt from any 3 of its 5 RS shards (the torn-
     tail contract of replay_records only covers the ACTIVE segment's
-    tail)."""
+    tail). `gaps_out` receives the store's settled-gap map (see
+    replay_records) for DataPlane.install."""
     from ripplemq_tpu.storage.erasure import repair_store
 
     repair_store(store_dir)
-    return replay_records(cfg, scan_store(store_dir, use_native))
+    return replay_records(cfg, scan_store(store_dir, use_native),
+                          gaps_out=gaps_out)
 
 
-def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
+def replay_records(cfg: EngineConfig, records,
+                   gaps_out: Optional[dict] = None) -> Optional[ReplicaState]:
     """Replay committed-round records into a single-replica state image.
 
     Returns None if there are no records. Only committed rounds are ever
@@ -2125,6 +2358,17 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
     positions (base % slots), so a partition that wrapped the ring many
     times replays to exactly the last `slots` rows — older rows stay
     store-only, served through the log index (core.state ring doc).
+
+    `gaps_out` (optional dict) receives {slot: [[begin, end), ...]} —
+    the COVERAGE HOLES between this store's records, below each slot's
+    final log end. A hole is a round the writing controller committed on
+    device but never settled (replication failed → never persisted):
+    exactly the settled gaps DataPlane.install must re-register, because
+    a hole inside the final ring window otherwise replays as the
+    PREVIOUS lap's rows at the wrong offsets. Ring rows inside such
+    holes are zeroed here too (zero rows read back as alignment
+    padding), so even a read path that misses the gap clamp cannot
+    serve a stale lap.
     """
     P, S, SB, C = cfg.partitions, cfg.slots, cfg.slot_bytes, cfg.max_consumers
     log_data = np.zeros((P, S + cfg.max_batch, SB), np.uint8)
@@ -2132,6 +2376,7 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
     last_term = np.zeros((P,), np.int32)
     commit = np.zeros((P,), np.int32)
     offsets = np.zeros((P, C), np.int32)
+    coverage: dict[int, list[list[int]]] = {}
     found = False
     for rec_type, slot, base, payload in records:
         if not 0 <= slot < P:
@@ -2159,6 +2404,18 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
             last_term[slot] = int(
                 np.frombuffer(rows[-1, 4:8].tobytes(), np.int32)[0]
             )
+            # Coverage bookkeeping mirrors the later-records-win replay:
+            # a regressing record drops/truncates everything at-or-above
+            # its base before extending (same rule as LogIndex.add).
+            cov = coverage.setdefault(slot, [])
+            while cov and cov[-1][0] >= base:
+                cov.pop()
+            if cov and cov[-1][1] > base:
+                cov[-1][1] = base
+            if cov and cov[-1][1] == base:
+                cov[-1][1] = base + n
+            else:
+                cov.append([base, base + n])
         elif rec_type == REC_OFFSETS:
             for cs, off in struct.iter_unpack("<II", payload):
                 if cs < C:
@@ -2166,6 +2423,32 @@ def replay_records(cfg: EngineConfig, records) -> Optional[ReplicaState]:
         found = True
     if not found:
         return None
+    for slot, cov in coverage.items():
+        gaps = [
+            [cov[i - 1][1], cov[i][0]]
+            for i in range(1, len(cov))
+            if cov[i][0] > cov[i - 1][1]
+        ]
+        if not gaps:
+            continue
+        end = int(log_end[slot])
+        for b, e in gaps:
+            # Zero the hole's rows inside the final ring window: they
+            # hold whatever an earlier lap's record replayed there. The
+            # window clamp bounds e - lo to at most S rows, so the range
+            # is at most two contiguous ring spans (split at the wrap).
+            lo = max(b, end - S)
+            if lo >= e:
+                continue
+            p0 = lo % S
+            n = e - lo
+            if p0 + n <= S:
+                log_data[slot, p0 : p0 + n] = 0
+            else:
+                log_data[slot, p0:S] = 0
+                log_data[slot, : p0 + n - S] = 0
+        if gaps_out is not None:
+            gaps_out[slot] = gaps
     return ReplicaState(
         log_data=log_data,
         log_end=log_end,

@@ -215,6 +215,33 @@ class PartitionManager:
 
     def _apply_set_topics(self, topics: list[Topic], live: list[int]) -> None:
         old_alive = self._alive_mask() if self.dataplane is not None else None
+        # Term-monotonic merge: the incoming assignment surface is a
+        # SNAPSHOT taken at proposal time on the metadata leader; an
+        # election that applied between snapshot and here would be
+        # reverted by installing it verbatim, regressing the advertised
+        # term below the device current_term (the permanent write wedge
+        # the chaos plane caught — no later election fires because the
+        # leader looks alive). Keep the newer (leader, term) wherever
+        # the current table is ahead; deterministic, so every replica's
+        # apply converges identically.
+        merged: list[Topic] = []
+        for t in topics:
+            cur = next((c for c in self.topics if c.name == t.name), None)
+            if cur is None:
+                merged.append(t)
+                continue
+            assigns = list(t.assignments)
+            for j, a in enumerate(assigns):
+                ca = cur.assignment_for(a.partition_id)
+                if ca is None or ca.term <= a.term:
+                    continue
+                keep = ca.leader if (ca.leader is None
+                                     or ca.leader in a.replicas) else None
+                assigns[j] = dataclasses.replace(
+                    a, leader=keep, term=ca.term
+                )
+            merged.append(t.with_assignments(tuple(assigns)))
+        topics = merged
         self.topics = topics
         self.live = live
         if self.dataplane is None:
@@ -237,6 +264,15 @@ class PartitionManager:
             assigns = list(t.assignments)
             for j, a in enumerate(assigns):
                 if a.partition_id == pid:
+                    if term < a.term:
+                        # Stale advert (terms only move forward): a
+                        # lower-term OP_SET_LEADER applying after a
+                        # newer election would regress the control
+                        # table below the device current_term — the
+                        # permanent write wedge the chaos plane caught.
+                        # Applies are deterministic across brokers, so
+                        # every replica skips it identically.
+                        return
                     assigns[j] = dataclasses.replace(a, leader=leader, term=term)
             self.topics[i] = t.with_assignments(tuple(assigns))
         if self.dataplane is not None:
@@ -548,21 +584,46 @@ class PartitionManager:
             live = set(self.live)
             R = self.dataplane.cfg.replicas
             now = time.monotonic()
+            # Device-term-skew wedge probe (host-only, no device fetch):
+            # a slot whose rounds ALL fail to commit despite a live
+            # leader is election-worthy — an election bumped the device
+            # current_term but its OP_SET_LEADER advert never stuck
+            # (proposal lost mid-chaos, or reverted by a stale
+            # OP_SET_TOPICS snapshot), so every round dispatches a stale
+            # term and is refused forever. plan_elections confirms the
+            # skew against the device terms before nominating.
+            stalled = set(self.dataplane.stalled_slots())
             for t in self.topics:
                 quorum = t.replication_factor // 2 + 1
                 for a in t.assignments:
                     slot = self.slot_map.get((t.name, a.partition_id))
                     if a.leader is not None and a.leader in live:
-                        # Clear the debounce stamp HERE, where healthy
-                        # leadership is observed every duty tick — not
-                        # only in plan_elections, which no longer runs on
-                        # healthy clusters (this pre-check exists to skip
-                        # it). A stale stamp from a previous outage would
-                        # otherwise void the debounce window for the next
-                        # one (r4 advisor).
-                        if slot is not None:
-                            self._leaderless_since.pop(slot, None)
-                        continue
+                        if slot is None:
+                            continue
+                        if slot not in stalled:
+                            # Clear STALE debounce stamps HERE, where
+                            # healthy leadership is observed every duty
+                            # tick — not only in plan_elections, which no
+                            # longer runs on healthy clusters (this
+                            # pre-check exists to skip it). A stale stamp
+                            # from a previous outage would otherwise void
+                            # the debounce window for the next one (r4
+                            # advisor). A FRESH stamp survives: the
+                            # term-aligned stall probe consumes the
+                            # streak (reset_stall) and re-stamps, so
+                            # popping its stamp on the next tick would
+                            # let a streak that re-builds faster than
+                            # the election window re-pay the
+                            # plan_elections device fetch per rebuild
+                            # instead of at most once per window.
+                            since = self._leaderless_since.get(slot)
+                            if (since is not None
+                                    and now - since
+                                    >= self.config.election_timeout_s):
+                                self._leaderless_since.pop(slot, None)
+                            continue
+                        # Live leader but stalled: actionable (same
+                        # debounce + quorum gates as leaderless below).
                     if slot is None:
                         continue
                     since = self._leaderless_since.get(slot)
@@ -591,6 +652,7 @@ class PartitionManager:
             if log_ends is None:
                 log_ends = self.dataplane.log_ends()      # [R, P]
             device_terms = self.dataplane.current_terms() # [P]
+            stalled = set(self.dataplane.stalled_slots())
             live = set(self.live)
             now = time.monotonic()
             cands: dict[int, tuple[int, int]] = {}
@@ -600,13 +662,61 @@ class PartitionManager:
                     slot = self.slot_map.get((t.name, a.partition_id))
                     if slot is None:
                         continue
+                    skew = False
                     if a.leader is not None and a.leader in live:
-                        self._leaderless_since.pop(slot, None)
-                        continue
+                        # Device-term-skew wedge (see needs_elections):
+                        # a live leader whose slot is stalled AND whose
+                        # device current_term ran ahead of the
+                        # advertised term can never commit again.
+                        # Anything else live-and-leading is healthy:
+                        # clear the debounce stamp and move on.
+                        if slot not in stalled:
+                            self._leaderless_since.pop(slot, None)
+                            continue
+                        if int(device_terms[slot]) <= a.term:
+                            # Stalled but term-aligned: an engine-quorum
+                            # outage elections cannot help. The probe
+                            # CONSUMES the stall evidence (reset_stall)
+                            # — a streak frozen by traffic stopping
+                            # right after the outage would otherwise
+                            # keep this device fetch firing at the
+                            # election timeout forever — and re-stamps
+                            # so a streak that re-builds faster than the
+                            # timeout still re-checks at most once per
+                            # window; the healthy branch above clears
+                            # the stamp once commits resume.
+                            self.dataplane.reset_stall(slot)
+                            self._leaderless_since[slot] = now
+                            continue
+                        skew = True
                     since = self._leaderless_since.setdefault(slot, now)
                     if now - since < self.config.election_timeout_s:
                         continue  # debounce (see __init__)
                     self._leaderless_since[slot] = now  # space retries too
+                    if skew:
+                        # Heal WITHOUT a new vote: the device already
+                        # granted a term the table never learned (the
+                        # OP_SET_LEADER advert was lost mid-chaos or
+                        # skipped as stale). A re-VOTE would bump the
+                        # device term again and — under load, where the
+                        # advert's raft round-trip outlasts the election
+                        # debounce — race its own advert forever (the
+                        # observed runaway: device term 165 vs table 75).
+                        # Appends ack at `inp.term >= current_term`, so
+                        # re-advertising the SAME leader at the device's
+                        # max granted term is all commit needs; the
+                        # device state never moves, so lost re-adverts
+                        # retry idempotently until one lands. No cands
+                        # entry: the duty proposes vote-less drafts
+                        # directly.
+                        drafts[slot] = {
+                            "op": OP_SET_LEADER,
+                            "topic": t.name,
+                            "partition": a.partition_id,
+                            "leader": a.leader,
+                            "term": int(device_terms[slot]),
+                        }
+                        continue
                     alive_replicas = [
                         (r, b)
                         for r, b in enumerate(a.replicas)

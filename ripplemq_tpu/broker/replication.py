@@ -266,6 +266,16 @@ class _Sender(threading.Thread):
                 if resp.get("error") == "stale_epoch":
                     settle_all(FencedError("standby reports newer epoch"))
                     break
+                if resp.get("error") == "store_quarantined":
+                    # The standby quarantined its store (reopened empty)
+                    # and is refusing acks under its stale pre-death
+                    # membership. Flag it suspect NOW — waiting out the
+                    # full ack timeout just stalls every round in the
+                    # window — so the duty loop prunes it from the set;
+                    # the ordinary standby-add then re-admits it through
+                    # the full catch-up stream, after which it acks again.
+                    with self._rep._lock:
+                        self._rep._suspects.add(self.broker_id)
                 # Transient standby-side refusal (e.g. it believes itself
                 # the active controller until its fence duty runs): retry.
                 failures += 1
@@ -480,6 +490,17 @@ class RoundReplicator:
                         # it. (This is exactly the shutdown race: a
                         # partitioned controller being stopped must not
                         # settle its stranded in-flight rounds.)
+                        # Withdraw the round's still-queued copies from
+                        # the OTHER senders first (same as the timeout
+                        # path): the caller records this round as a
+                        # settled GAP — nacked, invisible to reads — and
+                        # a copy still delivered to a standby store would
+                        # needlessly resurrect it at the next promotion
+                        # (harmless under later-record-wins replay, but a
+                        # nack should suppress what it can).
+                        for b, f in futs.items():
+                            if not f.done():
+                                senders[b].cancel(f)
                         raise
                     # Same deposition guard as the member-removed branch
                     # above: the fence duty STOPS the replicator in the

@@ -204,6 +204,14 @@ class BrokerServer:
         self._pending_shard_drops: list[tuple[int, str]] = []
         self._shard_push_seeded = False
         self._last_shard_push = 0.0
+        self._store_quarantined = False
+        # Since the last quarantine, has this broker been observed OUT of
+        # the replicated standby set? A broker that died IN the set boots
+        # with stale membership still naming it — which proves nothing
+        # about its (now emptied) store. Only an out-then-in transition
+        # means the controller re-ran the full catch-up stream before
+        # re-proposing membership (see _takeover_duty / _handle_repl_rounds).
+        self._quarantine_left_set = False
         if dataplane is not None:
             self._round_store = dataplane.store  # may be None
         elif data_dir is not None:
@@ -220,9 +228,16 @@ class BrokerServer:
             # the ordinary local heal rebuilds any missing/corrupt sealed
             # segment from any 3 of its 5 RS shards — all BEFORE opening
             # for append (the open creates a fresh active segment whose
-            # index must come after every recovered one).
+            # index must come after every recovered one). Damage that
+            # survives BOTH passes (a flipped record in the active
+            # segment, a lost sealed segment with no shard set) is
+            # quarantined: the broker reopens empty and re-replicates
+            # through standby catch-up instead of crash-looping at its
+            # next promotion or serving a CRC-failing row
+            # (_validate_or_quarantine_store).
             self._refill_shards_from_peers()
             repair_store(self._store_dir)
+            self._validate_or_quarantine_store()
             self._round_store = SegmentStore(
                 self._store_dir, erasure=True,
                 segment_bytes=config.segment_bytes,
@@ -373,8 +388,15 @@ class BrokerServer:
                 # applied, the repl.rounds fence refuses the stale
                 # stream, so nothing new lands mid-scan.
                 self._round_store.flush()
+                # Coverage holes in the recovered stream are rounds the
+                # writing controller nacked (committed on device, never
+                # settled): re-register them as settled gaps so the
+                # booted plane keeps refusing to serve them
+                # (replay_records gaps_out; ISSUE 4 residual window 2).
+                gaps = {}
                 image = replay_records(
-                    self.config.engine, self._round_store.scan()
+                    self.config.engine, self._round_store.scan(),
+                    gaps_out=gaps,
                 )
             dp = DataPlane(
                 self.config.engine, mode=self._engine_mode,
@@ -384,9 +406,10 @@ class BrokerServer:
                 chain_depth=self.config.chain_depth,
                 pipeline_depth=self.config.pipeline_depth,
                 read_coalesce_s=self.config.read_coalesce_s,
+                durability=self.config.durability,
             )
             if image is not None:
-                dp.install(image)
+                dp.install(image, settled_gaps=gaps)
             if self._round_store is not None:
                 self._wire_replicator(dp)
             self._owns_dataplane = True
@@ -407,6 +430,21 @@ class BrokerServer:
                     dp.stop()
                 except Exception:
                     log.exception("stopping partially-booted plane")
+            # A corrupt store can NEVER boot a plane, no matter how many
+            # times the replay retries — quarantine it now (the boot-time
+            # health walk only guards process start; damage surfacing at
+            # promotion time otherwise crash-loops the takeover duty
+            # forever, observed as ~1000 consecutive boot failures in the
+            # proc disk-fault drills). The reopened-empty store routes
+            # the next takeover tick through the quarantined-store path:
+            # abdicate to a standby holding the real stream, or boot
+            # empty as the genesis-equivalent last resort.
+            from ripplemq_tpu.storage.segment import CorruptStoreError
+
+            if (isinstance(e, CorruptStoreError) and self._owns_store
+                    and self._store_dir is not None
+                    and not self._store_quarantined):
+                self._quarantine_store_midlife(e)
             # After a few consecutive failures (grace for a worker that
             # is merely still starting), abdicate the same way a
             # mid-call lockstep break does.
@@ -596,6 +634,11 @@ class BrokerServer:
             # reset on success and on losing controllership) — makes a
             # boot-retry loop operator-visible instead of log-only.
             "boot_failures": self._boot_failures,
+            # True while the local committed-round store is a fresh
+            # replacement for a boot-time-quarantined one (disk damage
+            # beyond erasure repair); clears once standby catch-up
+            # re-transfers the full prefix.
+            "store_quarantined": self._store_quarantined,
             "metadata": {
                 "role": node.role,
                 "term": node.term,
@@ -631,6 +674,14 @@ class BrokerServer:
                 # through the locked accessor: the resolver mutates the
                 # gap dict concurrently.
                 "mirror_gap_slots": dp.mirror_gap_slots(),
+                # Slots carrying settled gaps (replication-FAILED rounds
+                # every read path skips) — same locked-accessor pattern.
+                "settled_gap_slots": dp.settled_gap_slots(),
+                # Slots whose recent rounds ALL failed to commit on
+                # device (the term-skew wedge probe feeding the duty's
+                # re-election gate) — non-empty here means the duty is
+                # about to heal, or the partition has no engine quorum.
+                "stalled_slots": dp.stalled_slots(),
                 "committed_entries": dp.committed_entries,
                 "step_errors": dp.step_errors,
                 # Settle-pipeline occupancy (pipelined standby
@@ -718,6 +769,77 @@ class BrokerServer:
                 pass  # already gone: drop is idempotent
             return {"ok": True}
         return {"ok": False, "error": f"unknown shard op {t!r}"}
+
+    def _validate_or_quarantine_store(self) -> None:
+        """Boot-time store health gate (after peer refill + erasure
+        repair): a store the scanners would refuse — a CRC-failing
+        record beyond the torn-tail contract, or a sealed segment FILE
+        still missing after both recovery passes — is moved aside
+        (`segments.quarantine-N`) and the broker reopens EMPTY. It then
+        rejoins as a standby and re-replicates the full committed-round
+        stream through the catch-up protocol; recovered-metadata
+        controllership over a quarantined store is refused by the
+        takeover duty (an emptied store must never boot a plane that
+        would serve an empty history as truth). Never crash-loop, never
+        serve a row that fails CRC."""
+        from ripplemq_tpu.storage.erasure import segment_index_gaps
+        from ripplemq_tpu.storage.segment import (
+            CorruptStoreError,
+            quarantine_store,
+            verify_store,
+        )
+
+        try:
+            if segment_index_gaps(self._store_dir):
+                raise CorruptStoreError(
+                    "sealed segment files missing after refill + repair"
+                )
+            # repair_torn_tail: the reopen below starts a NEW segment, so
+            # a merely-tolerated torn tail would seal into a segment every
+            # later scan refuses — truncate it off while it is still legal.
+            verify_store(self._store_dir, repair_torn_tail=True)
+        except CorruptStoreError as e:
+            target = quarantine_store(self._store_dir)
+            self._store_quarantined = True
+            log.warning(
+                "broker %d: store failed its boot health walk (%s); "
+                "quarantined to %s — reopening empty, will re-replicate "
+                "via standby catch-up", self.broker_id, e, target,
+            )
+
+    def _quarantine_store_midlife(self, cause: Exception) -> None:
+        """Quarantine a store whose damage surfaced AFTER boot (a replay
+        scan raising mid-promotion) and reopen it empty. Same contract
+        as the boot-time gate: the damaged bytes move aside for
+        forensics, `_store_quarantined` keeps the takeover duty from
+        booting a plane that would serve the emptied history as truth,
+        and the flag clears once standby catch-up re-admits this broker
+        with the full stream. Concurrent repl appends against the OLD
+        store object fail harmlessly (their segment paths moved) and the
+        controller's retry lands on the fresh store."""
+        from ripplemq_tpu.storage.segment import (
+            SegmentStore,
+            quarantine_store,
+        )
+
+        try:
+            self._round_store.close()
+        except Exception:
+            log.exception("closing store ahead of mid-life quarantine")
+        target = quarantine_store(self._store_dir)
+        self._store_quarantined = True
+        self._quarantine_left_set = False
+        self._round_store = SegmentStore(
+            self._store_dir, erasure=True,
+            segment_bytes=self.config.segment_bytes,
+            retention_bytes=self.config.store_retention_bytes,
+        )
+        log.warning(
+            "broker %d: store failed its replay scan mid-life (%s: %s); "
+            "quarantined to %s — reopening empty, will re-replicate via "
+            "standby catch-up", self.broker_id, type(cause).__name__,
+            cause, target,
+        )
 
     def _refill_shards_from_peers(self) -> None:
         """Boot-time disaster recovery: pull peer-held shard copies for
@@ -1253,19 +1375,30 @@ class BrokerServer:
             # above stays valid: rows arriving during the wait are
             # NEWER than the proof, never staler).
             deadline = time.monotonic() + min(wait_s, self._LONG_POLL_CAP_S)
+            # Park RELATIVE to the read's advance: an empty-but-advanced
+            # answer (offset below a settled gap or an all-padding tail)
+            # moves the wake watermark to its end, so the wait arms on
+            # rows settling PAST the dead range instead of re-reading
+            # the same advance every tick for the whole window — and the
+            # window still parks (one RPC per delivery, not one per
+            # client poll) when the tail past the advance is idle. The
+            # advance itself reaches the client in `end` either way.
+            wait_from = max(offset, end)
             while time.monotonic() < deadline:
                 if self._stop.wait(timeout=0.01):
                     break
                 if self._local_engine() is not dp:
                     break  # deposed mid-wait: refuse via the normal path
-                # LOCK-FREE probe: an aligned int64 element read; a
-                # stale value only delays one tick, and dozens of
-                # parked consumers must not hammer the control lock
-                # the drain and settle threads live under.
-                if int(dp._settled_end[slot]) > offset:
-                    msgs, end = dp.read(slot, offset, replica, max_msgs)
+                # Locked accessor (the mirror_gap_slots advisor
+                # pattern): the settle thread mutates the horizon and
+                # the gap table together, and a bare array reach-in
+                # here was the one read-side consumer of plane
+                # internals outside the plane's own lock discipline.
+                if dp.settled_end(slot) > wait_from:
+                    msgs, end = dp.read(slot, wait_from, replica, max_msgs)
                     if msgs:
                         break
+                    wait_from = max(wait_from, end)
             return msgs, end
         resp = self._engine_call(
             {"type": "engine.read", "slot": slot, "offset": offset,
@@ -1349,6 +1482,17 @@ class BrokerServer:
             # at ours): refuse non-fatally; the sender retries until the
             # fence duty on one side resolves it.
             return {"ok": False, "error": "active_controller"}
+        if self._store_quarantined and not self._quarantine_left_set:
+            # This broker's store was quarantined (reopened EMPTY) while
+            # the replicated metadata still lists it as a standby from
+            # BEFORE it died. Acking live rounds now would keep that
+            # stale membership looking healthy — and a later promotion
+            # would serve the suffix-only store as the full history
+            # (observed in the proc disk-fault drills as a total acked-
+            # history reset). Refuse until the controller prunes us from
+            # the set (the sender flags us suspect on this error) and
+            # re-admits via the full catch-up stream.
+            return {"ok": False, "error": "store_quarantined"}
         store = self._round_store
         if store is None:
             return {"ok": False, "error": "no_store"}
@@ -1359,6 +1503,15 @@ class BrokerServer:
         else:
             for rec in recs:
                 store.append(*rec)
+        if self.config.durability == "strict":
+            # durability=strict: this ack gates a settled round's
+            # producer ack, so the records must be ON DISK before it
+            # returns — strict deployments opt out of the flush_async
+            # one-interval lag on the standby path too (the controller's
+            # settle-side persist honors the same knob,
+            # DataPlane._persist_round).
+            store.flush()
+            return {"ok": True}
         now = time.monotonic()
         if now - self._repl_last_flush >= 0.05:
             # Deferred fsync (SegmentStore.flush_async): the ack this
@@ -1495,6 +1648,25 @@ class BrokerServer:
         is lost across the handover. Gated on metadata freshness: a
         restarted broker's recovered metadata may name it controller in
         an epoch the cluster has already left (see __init__)."""
+        if self._store_quarantined:
+            in_set = self.broker_id in self.manager.current_standbys()
+            if not in_set:
+                self._quarantine_left_set = True
+            elif self._quarantine_left_set:
+                # Out-then-in: the controller pruned this broker after
+                # the quarantine (repl acks refused until then) and
+                # re-admitted it through the full catch-up stream — set
+                # membership is proposed only after the whole store
+                # prefix (plus buffered live rounds) transferred, so the
+                # reopened store is whole again. Cleared HERE — while
+                # still a standby — because the promotion that might
+                # follow removes the promoted broker from the standby
+                # list in the same apply. Membership WITHOUT the
+                # out-transition is stale pre-death metadata and proves
+                # nothing (a promoted stale member served an emptied
+                # history as truth in the proc disk-fault drills).
+                self._store_quarantined = False
+                self._quarantine_left_set = False
         if self.dataplane is not None:
             return
         if self.manager.current_controller() != self.broker_id:
@@ -1508,6 +1680,31 @@ class BrokerServer:
             return
         if not self._metadata_current():
             return  # recovered claim unconfirmed; retry next duty tick
+        if self._store_quarantined:
+            # The local stream was quarantined at boot (disk damage
+            # beyond repair) and the store reopened EMPTY: booting a
+            # plane from it would serve an empty history as truth —
+            # acked loss by construction. Hand controllership to a
+            # standby holding the real stream; this broker rejoins as a
+            # standby and the flag clears once catch-up re-admits it
+            # (the check at the top of this duty).
+            cmd = self.manager.plan_abdication()
+            if cmd is not None:
+                log.warning(
+                    "broker %d: refusing to boot a plane from a "
+                    "quarantined store; abdicating to broker %d",
+                    self.broker_id, cmd["controller"],
+                )
+                self.propose_cmd(cmd)
+                return
+            # No live standby to hand to: the quarantined copy was the
+            # best anyone has — boot empty rather than stall the whole
+            # cluster forever (genesis-equivalent restart).
+            log.warning(
+                "broker %d: quarantined store and no standby to "
+                "abdicate to; booting empty", self.broker_id,
+            )
+            self._store_quarantined = False
         self._boot_dataplane()
 
     def _controller_duty(self) -> None:
@@ -1547,9 +1744,12 @@ class BrokerServer:
         # (elections don't move log ends, so the snapshot stays valid).
         log_ends = dp.log_ends()
         cands, drafts = self.manager.plan_elections(log_ends)
-        if cands:
-            winners = dp.elect(cands)
+        if drafts:
+            winners = dp.elect(cands) if cands else {}
             won = [drafts[slot] for slot, w in winners.items() if w]
+            # Vote-less drafts (device-term-skew heals): the device
+            # already granted the term; only the advert is missing.
+            won += [d for slot, d in drafts.items() if slot not in cands]
             # ONE replicated command advertises every winner of the
             # batched ballot (chunked to bound the entry size): a
             # thousand-partition election wave — bootstrap or failover —

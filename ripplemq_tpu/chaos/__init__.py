@@ -26,18 +26,28 @@ The pieces (each importable on its own):
   cluster, runs producer/consumer workloads through the REAL client
   SDK (retry policies included), lets the nemesis attack it, heals,
   waits for re-convergence, drains the logs, and returns a JSON-able
-  verdict.
+  verdict. `run_kill_all_drill` is the correlated full-cluster SIGKILL
+  durability drill (the `flush_async` contract, `durability=strict`
+  opt-out).
+- `chaos.proc_cluster` — the PROCESS-LEVEL backend: real
+  `python -m ripplemq_tpu.broker` subprocesses, real TCP, real on-disk
+  stores; `run_chaos(backend="proc")` drives SIGKILL/restart and
+  disk-fault schedules (chaos.diskfaults: torn tail, flipped byte,
+  lost sealed segment) against the deployment shape.
 """
 
 from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
-from ripplemq_tpu.chaos.harness import run_chaos
+from ripplemq_tpu.chaos.harness import run_chaos, run_kill_all_drill
 from ripplemq_tpu.chaos.history import History, check_history
 from ripplemq_tpu.chaos.nemesis import Nemesis, make_schedule
+from ripplemq_tpu.chaos.proc_cluster import ProcCluster
 
 __all__ = [
     "InProcCluster",
+    "ProcCluster",
     "make_cluster_config",
     "run_chaos",
+    "run_kill_all_drill",
     "History",
     "check_history",
     "Nemesis",

@@ -123,9 +123,55 @@ class InProcCluster:
     def __exit__(self, *exc):
         self.stop()
 
-    # -- convenience --
+    # -- chaos capability surface (mirrored by chaos.proc_cluster) --
     def client(self, name="client"):
         return self.net.client(name)
+
+    def broker_addr(self, broker_id: int) -> str:
+        return self.config.broker(broker_id).address
+
+    def leader_of_key(self, topic: str, pid: int, exclude=()):
+        """Partition leader as seen by any non-excluded broker (the
+        nemesis excludes its currently-crashed set)."""
+        any_b = next(
+            (b for i, b in self.brokers.items() if i not in exclude), None
+        )
+        if any_b is None:
+            return None
+        return any_b.manager.leader_of((topic, pid))
+
+    def controller_ready(self) -> bool:
+        """Controller known with >= 1 replication standby joined (the
+        precondition chaos runs wait for before the first crash)."""
+        any_b = next(iter(self.brokers.values()))
+        ctrl = any_b.manager.current_controller()
+        return (ctrl in self.brokers
+                and bool(self.brokers[ctrl].manager.current_standbys()))
+
+    def inject_disk_fault(self, broker_id: int, kind: str,
+                          salt: int = 0) -> dict:
+        """Damage a KILLED broker's on-disk store (requires a data_dir
+        cluster; the kill closed the store, the restart must rebuild or
+        quarantine)."""
+        from ripplemq_tpu.chaos.diskfaults import inject_disk_fault
+
+        if self._data_dir is None:
+            raise RuntimeError("disk faults need a data_dir cluster")
+        if not self.brokers[broker_id]._stopped:
+            # Mirror ProcCluster's guard: damaging a store a LIVE
+            # BrokerServer holds open desyncs its append position from
+            # the file — later appends interleave garbage frames and the
+            # run reports corruption unrelated to the scheduled fault
+            # instead of testing recovery.
+            raise RuntimeError(
+                f"broker {broker_id} is alive: disk faults are injected "
+                f"between kill and restart"
+            )
+        import os
+
+        store_dir = os.path.join(str(self._data_dir),
+                                 f"broker-{broker_id}", "segments")
+        return inject_disk_fault(store_dir, kind, salt)
 
     def wait_for_leaders(self, timeout=30.0) -> None:
         """Block until every configured partition has an advertised leader

@@ -153,23 +153,34 @@ def _drain_partition(cluster: InProcCluster, topic: str, pid: int,
         retries=5, retry_backoff_s=0.05,
     )
     out: list[str] = []
-    empty = 0
     deadline = time.time() + timeout_s
+    # End on a sustained window of CLEAN empty reads, not a fixed count:
+    # three empty batches are ~150 ms apart, and a post-heal cluster on a
+    # starved host can legitimately answer empty for longer than that
+    # while its settle horizon catches up — a count-based stop truncated
+    # the drain's tail there and read as false acked loss (the last one
+    # or two produces "absent from the final log" whenever tier-1 shared
+    # the host with other work).
+    last_progress = time.time()
     try:
-        while empty < 3 and time.time() < deadline:
+        while time.time() < deadline:
             try:
                 batch = consumer.consume(topic, partition=pid,
                                          max_messages=64)
             except Exception:
                 # Post-heal leadership/metadata can still be settling;
-                # the drain just needs the eventual full prefix.
+                # the drain just needs the eventual full prefix. An
+                # erroring cluster is "still settling", not "drained" —
+                # keep the progress clock running.
+                last_progress = time.time()
                 time.sleep(0.1)
                 continue
             if batch:
-                empty = 0
+                last_progress = time.time()
                 out.extend(m.decode("utf-8", "replace") for m in batch)
             else:
-                empty += 1
+                if time.time() - last_progress > 3.0:
+                    break
                 time.sleep(0.05)
     finally:
         consumer.close()
@@ -188,25 +199,18 @@ def run_chaos(
     schedule: Optional[list[list[dict]]] = None,
     converge_timeout_s: float = 30.0,
     include_history: bool = False,
+    backend: str = "inproc",
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
-    by phase) to REPLAY instead of generating from the seed."""
+    by phase) to REPLAY instead of generating from the seed.
+
+    `backend` picks the cluster substrate: "inproc" (single process,
+    fake transport — network faults, fastest) or "proc" (real broker
+    subprocesses over TCP — SIGKILL + disk-fault schedules against the
+    deployment shape; chaos.proc_cluster). Verdict schema is identical."""
     t0 = time.time()
     topic = "chaos"
-    config = make_cluster_config(
-        n_brokers=n_brokers,
-        topics=(Topic(topic, partitions, replication),),
-        rpc_timeout_s=3.0,
-        # The checker asserts offset monotonicity and committed-prefix
-        # consistency ACROSS controller moves; with linearizable_reads
-        # off, a deposed-but-partitioned controller may serve stale
-        # reads (the DOCUMENTED anomaly, README "deviations") and the
-        # checker would flag the contract the deployment opted out of.
-        # The chaos cluster opts IN, so every surviving violation is a
-        # real bug.
-        linearizable_reads=True,
-    )
     tmp = None
     if data_dir is None:
         # Durable stores are load-bearing: an in-proc restart recovers
@@ -214,23 +218,48 @@ def run_chaos(
         # no-acked-loss invariant CHECKABLE under controller crashes
         # even before a standby forms.
         tmp = data_dir = tempfile.mkdtemp(prefix=f"chaos-{seed}-")
+    if backend == "proc":
+        from ripplemq_tpu.chaos.proc_cluster import (
+            ProcCluster,
+            free_ports,
+            make_proc_cluster_config,
+        )
+
+        config = make_proc_cluster_config(
+            free_ports(n_brokers),
+            topics=(Topic(topic, partitions, replication),),
+            linearizable_reads=True,  # same checker rationale as below
+        )
+        cluster = ProcCluster(config=config, data_dir=data_dir)
+    else:
+        config = make_cluster_config(
+            n_brokers=n_brokers,
+            topics=(Topic(topic, partitions, replication),),
+            rpc_timeout_s=3.0,
+            # The checker asserts offset monotonicity and committed-
+            # prefix consistency ACROSS controller moves; with
+            # linearizable_reads off, a deposed-but-partitioned
+            # controller may serve stale reads (the DOCUMENTED anomaly,
+            # README "deviations") and the checker would flag the
+            # contract the deployment opted out of. The chaos cluster
+            # opts IN, so every surviving violation is a real bug.
+            linearizable_reads=True,
+        )
+        cluster = InProcCluster(config, data_dir=data_dir)
     history = History()
     verdict: dict = {"seed": seed, "phases": phases,
-                     "ops_per_phase": ops_per_phase}
-    cluster = InProcCluster(config, data_dir=data_dir)
+                     "ops_per_phase": ops_per_phase, "backend": backend}
     try:
         cluster.start()
         cluster.wait_for_leaders()
         nemesis = Nemesis(cluster, seed, phases,
-                          ops_per_phase=ops_per_phase, schedule=schedule)
+                          ops_per_phase=ops_per_phase, schedule=schedule,
+                          backend=backend)
         # Wait for one replication standby before the first crash:
         # settled appends are then provably on a promotable peer.
-        deadline = time.time() + 20
+        deadline = time.time() + (120 if backend == "proc" else 20)
         while time.time() < deadline:
-            ctrl = next(iter(cluster.brokers.values())
-                        ).manager.current_controller()
-            if (ctrl in cluster.brokers
-                    and cluster.brokers[ctrl].manager.current_standbys()):
+            if cluster.controller_ready():
                 break
             time.sleep(0.05)
         workload = _Workload(cluster, seed, history, topic, partitions)
@@ -264,13 +293,18 @@ def run_chaos(
         # duplication was actually DELIVERED (handler ran twice) — a
         # scheduled dup whose charge was eaten by a concurrent
         # block/drop never duplicated anything, and the invariant
-        # must stay armed for that run.
-        dup_faults = cluster.net.dups_applied > 0
+        # must stay armed for that run. (The proc backend has no
+        # injection network and so never duplicates.)
+        net = getattr(cluster, "net", None)
+        dup_faults = net is not None and net.dups_applied > 0
         violations = check_history(history.ops(), final_logs,
                                    allow_wire_dups=dup_faults)
         ops = history.ops()
         verdict.update(
             trace=nemesis.trace,
+            # Injection forensics (what the disk ops actually hit) —
+            # informational, NOT part of the byte-reproducible trace.
+            disk_faults=nemesis.disk_fault_log,
             schedule_digest=hashlib.sha256(
                 trace_json(nemesis.trace).encode()
             ).hexdigest(),
@@ -305,6 +339,116 @@ def run_chaos(
                 f"{t}[{p}]": v for (t, p), v in final_logs.items()
             }
         return verdict
+    finally:
+        cluster.stop()
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_kill_all_drill(seed: int = 0, durability: str = "async",
+                       n_msgs: int = 30,
+                       data_dir: Optional[str] = None,
+                       flush_lag_bound_s: float = 1.0) -> dict:
+    """Correlated FULL-CLUSTER SIGKILL durability drill (proc backend):
+    produce acked messages against a live 3-broker process cluster,
+    SIGKILL every broker at once, restart them all, drain, and hold the
+    history to the `flush_async` durability contract — acked loss only
+    inside the one-flush-interval window before the kill
+    (`flush_lag_bound_s` is the checker's conservative envelope for it).
+    With `durability="strict"` every settled round fsync'd before its
+    ack, so the grace window is EMPTY: zero acked loss, full stop."""
+    from ripplemq_tpu.chaos.proc_cluster import (
+        ProcCluster,
+        free_ports,
+        make_proc_cluster_config,
+    )
+    from ripplemq_tpu.client import ProducerClient
+
+    t0 = time.time()
+    topic = "drill"
+    tmp = None
+    if data_dir is None:
+        tmp = data_dir = tempfile.mkdtemp(prefix=f"drill-{seed}-")
+    config = make_proc_cluster_config(
+        free_ports(3), topics=(Topic(topic, 1, 3),), durability=durability,
+    )
+    cluster = ProcCluster(config=config, data_dir=data_dir)
+    history = History()
+    try:
+        cluster.start()
+        cluster.wait_for_leaders()
+        deadline = time.time() + 120
+        while time.time() < deadline and not cluster.controller_ready():
+            time.sleep(0.05)
+        bootstrap = [b.address for b in config.brokers]
+        producer = ProducerClient(
+            bootstrap, transport=cluster.client(f"drill-{seed}"),
+            metadata_refresh_s=0.5, rpc_timeout_s=5.0,
+        )
+        acked = 0
+
+        def produce_batch(lo: int, hi: int) -> None:
+            nonlocal acked
+            for i in range(lo, hi):
+                payload = f"drill:{seed}:{i}"
+                try:
+                    producer.produce(topic, payload.encode(), partition=0)
+                except Exception as e:
+                    history.record(op="produce", client="drill",
+                                   topic=topic, partition=0,
+                                   payload=payload, status="fail",
+                                   error=f"{type(e).__name__}: {e}")
+                else:
+                    acked += 1
+                    # Recorded AFTER the ack: `t` is the ack time the
+                    # flush-lag window is measured against.
+                    history.record(op="produce", client="drill",
+                                   topic=topic, partition=0,
+                                   payload=payload, status="ok")
+
+        try:
+            # Two batches bracketing the flush cadence, so BOTH halves
+            # of the async contract are live: back-to-back localhost
+            # produces all finish inside flush_lag_bound_s, and killing
+            # right away would drop every ack into the grace window —
+            # making the no-loss check vacuous. The settle between the
+            # batches pushes the first one OUTSIDE the window (a
+            # regression losing rounds older than one flush interval now
+            # fails the drill in async mode too); the second batch lands
+            # inside it, where async may lose and strict may not.
+            produce_batch(0, n_msgs // 2)
+            time.sleep(flush_lag_bound_s + 0.2)
+            produce_batch(n_msgs // 2, n_msgs)
+        finally:
+            producer.close()
+        t_kill = cluster.kill_all()
+        for bid in cluster.brokers:
+            cluster.restart(bid)
+        cluster.wait_for_leaders()
+        final = _drain_partition(cluster, topic, 0, tag=f"drill-{seed}",
+                                 timeout_s=60.0)
+        # The contract under test: strict ⇒ no grace at all; async ⇒
+        # only acks inside the pre-kill flush-lag window may be lost.
+        grace = (
+            [] if durability == "strict"
+            else [(t_kill - flush_lag_bound_s, t_kill)]
+        )
+        violations = check_history(
+            history.ops(), {(topic, 0): final}, loss_grace=grace,
+        )
+        return {
+            "seed": seed,
+            "durability": durability,
+            "backend": "proc",
+            "acked": acked,
+            "final_log_size": len(final),
+            "kill_time": t_kill,
+            "flush_lag_bound_s": 0.0 if durability == "strict"
+            else flush_lag_bound_s,
+            "violations": violations,
+            "safe": not violations and acked > 0,
+            "elapsed_s": round(time.time() - t0, 3),
+        }
     finally:
         cluster.stop()
         if tmp is not None:

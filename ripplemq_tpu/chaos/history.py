@@ -92,7 +92,9 @@ def _subsequence_gap(needle: list[str], hay: list[str]) -> Optional[str]:
 
 def check_history(ops: list[dict],
                   final_logs: dict[tuple[str, int], list[str]],
-                  allow_wire_dups: bool = False) -> list[str]:
+                  allow_wire_dups: bool = False,
+                  loss_grace: Optional[list[tuple[float, float]]] = None,
+                  ) -> list[str]:
     """Return the list of invariant violations (empty = safe).
 
     `ops`: History.ops(). `final_logs`: {(topic, partition): [payload,
@@ -101,6 +103,16 @@ def check_history(ops: list[dict],
     (`dup_next`) — a duplicated produce/forward RPC legitimately
     appends twice (the wire is at-least-once, there is no idempotent
     producer id), so the clean-ack exactly-once check is suspended.
+
+    `loss_grace`: wall-clock [(t0, t1)] windows inside which an acked
+    produce is EXEMPT from the no-loss check — the `flush_async`
+    durability contract made explicit (ISSUE 4): zero acked loss while
+    any quorum member of a round survives un-killed (random schedules
+    keep a majority alive, so they pass no windows and the check stays
+    absolute); after a CORRELATED full-cluster kill, acked loss is
+    bounded by one flush interval, and the kill-all drill passes the
+    pre-kill window here. `durability=strict` deployments opt out of
+    the lag entirely — the drill passes no window for them either.
     """
     violations: list[str] = []
     produced: dict[str, dict] = {}
@@ -119,11 +131,16 @@ def check_history(ops: list[dict],
         part = (op["topic"], op["partition"])
         n = log_counts.get(part, {}).get(payload, 0)
         if op["status"] == "ok" and n == 0:
-            violations.append(
-                f"acked loss: produce {payload!r} -> {part} acked "
-                f"(attempts={op.get('attempts', 1)}) but absent from the "
-                f"final log"
+            t = op.get("t")
+            in_grace = loss_grace is not None and t is not None and any(
+                t0 <= t <= t1 for t0, t1 in loss_grace
             )
+            if not in_grace:
+                violations.append(
+                    f"acked loss: produce {payload!r} -> {part} acked "
+                    f"(attempts={op.get('attempts', 1)}) but absent from "
+                    f"the final log"
+                )
         if (op["status"] == "ok" and op.get("attempts", 1) == 1 and n > 1
                 and not allow_wire_dups):
             violations.append(

@@ -46,6 +46,21 @@ _OP_WEIGHTS = (
     ("dup", 2),
 )
 
+# The PROCESS backend's pool (chaos.proc_cluster): real kernels take no
+# InProcNetwork hooks, so the op set is what real deployments suffer —
+# SIGKILL'd processes and damaged disks (torn tail / flipped byte /
+# lost sealed segment), injected between a victim's kill and restart.
+_PROC_OP_WEIGHTS = (
+    ("crash", 4),
+    ("disk_torn", 2),
+    ("disk_flip", 2),
+    ("disk_trunc", 1),
+)
+
+_DISK_OPS = ("disk_torn", "disk_flip", "disk_trunc")
+
+_BACKEND_POOLS = {"inproc": _OP_WEIGHTS, "proc": _PROC_OP_WEIGHTS}
+
 
 def make_schedule(
     seed: int,
@@ -53,13 +68,17 @@ def make_schedule(
     phases: int,
     ops_per_phase: int = 2,
     lockstep_workers: tuple[str, ...] = (),
+    backend: str = "inproc",
 ) -> list[list[dict]]:
     """Deterministic [phases][ops] fault schedule. Each phase ends with
     an implicit heal (the nemesis records it in the trace), so phases
-    start from a clean network with every broker up."""
+    start from a clean network with every broker up. `backend` selects
+    the op pool ("inproc": network+crash faults; "proc": SIGKILL + disk
+    faults) — the schedule stays a pure function of (seed, roster,
+    shape, backend), so either backend's runs replay byte-for-byte."""
     rng = random.Random(seed)
-    pool = list(_OP_WEIGHTS)
-    if lockstep_workers:
+    pool = list(_BACKEND_POOLS[backend])
+    if lockstep_workers and backend == "inproc":
         pool.append(("kill_worker", 1))
     names = [n for n, w in pool for _ in range(w)]
     max_crashed = (len(broker_ids) - 1) // 2
@@ -70,8 +89,23 @@ def make_schedule(
         for _ in range(ops_per_phase):
             name = rng.choice(names)
             if name == "crash" and len(crashed) >= max_crashed:
-                name = "partition"  # keep the metadata majority alive
-            if name == "crash":
+                # Keep the metadata majority alive: the checker tests
+                # safety under faults the system claims to survive.
+                name = "partition" if backend == "inproc" else "disk_torn"
+            if name in _DISK_OPS:
+                # Disk damage is injected into a CRASHED victim's store
+                # (you cannot corrupt the disk under a live process and
+                # call the outcome a recovery test): target an already-
+                # crashed broker, or crash one first as part of the op.
+                if not crashed:
+                    b = rng.choice(sorted(broker_ids))
+                    crashed.add(b)
+                    ops.append({"op": "crash", "broker": b})
+                else:
+                    b = rng.choice(sorted(crashed))
+                ops.append({"op": name, "broker": b,
+                            "salt": rng.randint(0, 1 << 30)})
+            elif name == "crash":
                 b = rng.choice(sorted(set(broker_ids) - crashed))
                 crashed.add(b)
                 ops.append({"op": "crash", "broker": b})
@@ -131,16 +165,23 @@ class Nemesis:
     def __init__(self, cluster, seed: int, phases: int,
                  ops_per_phase: int = 2,
                  lockstep_workers: tuple[str, ...] = (),
-                 schedule: Optional[list[list[dict]]] = None) -> None:
+                 schedule: Optional[list[list[dict]]] = None,
+                 backend: str = "inproc") -> None:
         self.cluster = cluster
         self.seed = seed
+        self.backend = backend
         self.lockstep_workers = tuple(lockstep_workers)
         self.schedule = schedule if schedule is not None else make_schedule(
             seed, sorted(cluster.brokers), phases,
             ops_per_phase=ops_per_phase,
             lockstep_workers=self.lockstep_workers,
+            backend=backend,
         )
         self.trace: list[dict] = []
+        # Disk-fault injection outcomes, parallel to the trace entries
+        # that caused them (forensics; NOT part of the byte-reproducible
+        # trace — what the damage hit depends on what the run persisted).
+        self.disk_fault_log: list[dict] = []
         self._crashed: set[int] = set()
 
     # ------------------------------------------------------------- applying
@@ -154,19 +195,35 @@ class Nemesis:
             self.trace.append({"phase": phase, **op})
 
     def _apply(self, op: dict) -> None:
-        net = self.cluster.net
         kind = op["op"]
         if kind == "crash":
             b = op["broker"]
             if b not in self._crashed:
                 self._crashed.add(b)
                 self.cluster.kill(b)
-        elif kind == "restart":
+            return
+        if kind == "restart":
             b = op["broker"]
             if b in self._crashed:
                 self._crashed.discard(b)
                 self.cluster.restart(b)
-        elif kind == "isolate":
+            return
+        if kind in _DISK_OPS:
+            # Damage the crashed victim's on-disk store; the restart at
+            # heal must rebuild (erasure) or quarantine — never crash-
+            # loop, never serve a CRC-failing row.
+            desc = self.cluster.inject_disk_fault(
+                op["broker"], kind, op.get("salt", 0)
+            )
+            self.disk_fault_log.append(
+                {"broker": op["broker"], **desc}
+            )
+            return
+        # Network-layer ops: only reachable on backends with an in-proc
+        # fault-injection network (make_schedule never draws them for
+        # the process backend).
+        net = self.cluster.net
+        if kind == "isolate":
             me = self._addr(op["broker"])
             for other in self.cluster.brokers:
                 if other != op["broker"]:
@@ -189,14 +246,19 @@ class Nemesis:
 
     def heal_phase(self, phase: int) -> None:
         """End-of-phase heal: clear every network fault, restart every
-        crashed broker (recorded — the heal is part of the trace)."""
-        self.cluster.net.heal()
+        crashed broker (recorded — the heal is part of the trace). A
+        restart after a disk-fault op is where the recovery contract is
+        earned: the victim's boot must rebuild or quarantine the damage."""
+        net = getattr(self.cluster, "net", None)
+        if net is not None:
+            net.heal()
         for b in sorted(self._crashed):
             self.cluster.restart(b)
             self.trace.append({"phase": phase, "op": "restart", "broker": b})
         self._crashed.clear()
-        for w in self.lockstep_workers:
-            self.cluster.net.set_up(w)
+        if net is not None:
+            for w in self.lockstep_workers:
+                net.set_up(w)
         self.trace.append({"phase": phase, "op": "heal"})
 
     # ---------------------------------------------------------- convergence
@@ -219,11 +281,8 @@ class Nemesis:
         probe_i = 0
         while pending and time.time() < deadline:
             topic, pid = pending[0]
-            any_b = next(
-                b for i, b in self.cluster.brokers.items()
-                if i not in self._crashed
-            )
-            leader = any_b.manager.leader_of((topic, pid))
+            leader = self.cluster.leader_of_key(topic, pid,
+                                                exclude=self._crashed)
             if leader is None or leader in self._crashed:
                 time.sleep(0.05)
                 continue
@@ -239,7 +298,7 @@ class Nemesis:
                                payload=payload, status="unknown", attempts=1)
             try:
                 resp = client.call(
-                    self.cluster.brokers[leader].addr,
+                    self.cluster.broker_addr(leader),
                     {"type": "produce", "topic": topic, "partition": pid,
                      "messages": [payload.encode()]},
                     timeout=5.0,

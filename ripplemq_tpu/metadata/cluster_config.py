@@ -91,6 +91,17 @@ class ClusterConfig:
     # read BATCH (concurrent readers share one barrier; an
     # unconfirmable read refuses with not_committed instead of serving).
     linearizable_reads: bool = False
+    # Durability mode for the settle-path persists (controller AND
+    # standby ack path). "async" (default): fsync rides the store's
+    # flusher thread at the flush-interval cadence, so disk lags an ack
+    # by at most one interval — a correlated FULL-CLUSTER crash (power
+    # loss; a SIGKILL alone leaves the page cache intact) can lose that
+    # window of acked rounds, and nothing less can (any surviving quorum
+    # member of a round holds it). "strict": every settled round fsyncs
+    # synchronously before its acks release — zero acked loss even
+    # across a correlated full-cluster crash, at the cost of one fsync
+    # latency on every round's ack path.
+    durability: str = "async"
     # RPC worker pool per broker. A produce/engine.append handler BLOCKS
     # its worker until the round commits, so this caps a broker's
     # in-flight appends — size it to the offered concurrency (threads
@@ -100,6 +111,11 @@ class ClusterConfig:
     rpc_workers: int = 16
 
     def __post_init__(self) -> None:
+        if self.durability not in ("async", "strict"):
+            raise ValueError(
+                f"durability must be 'async' or 'strict', "
+                f"got {self.durability!r}"
+            )
         # Shards (~segment_bytes / 3 each) travel in single wire frames
         # (shard.put / shard.get), which the codec hard-caps at 64 MB —
         # an oversize segment would make shard distribution fail forever.
@@ -214,6 +230,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["rpc_workers"] = int(raw["rpc_workers"])
     if "linearizable_reads" in raw:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
+    if "durability" in raw:
+        extra["durability"] = str(raw["durability"])
     if "coalesce_s" in raw:
         extra["coalesce_s"] = float(raw["coalesce_s"])
     if "read_coalesce_s" in raw:

@@ -31,6 +31,28 @@ REC_META = 3
 
 _MAGIC = 0x474C5152
 _HEADER = struct.Struct("<IBIIII")  # magic, type, slot, base, len, crc
+_HEADER_PREFIX = struct.Struct("<IBIII")  # the 17 bytes the crc covers
+_CRC = struct.Struct("<I")
+
+
+def _frame_crc(header17: bytes, payload: bytes) -> int:
+    """CRC-32 of a record frame: the 17 header bytes BEFORE the crc
+    field, chained with the payload. Header corruption (a flipped bit
+    in type/slot/base/len) must fail verification exactly like payload
+    rot — a payload-only crc let a bit-flipped `base` pass the boot
+    health walk and replay acked rows at the wrong offsets (the chaos
+    disk_flip matrix; sealed+erasure-encoded segments were covered by
+    the shard-level whole-file crc, but the active and not-yet-encoded
+    segments were not).
+
+    FORMAT BREAK (PR 4): frames written by the pre-PR-4 payload-only
+    crc fail this check — deliberately unversioned, because a legacy
+    fallback would accept exactly the header damage this closes (a
+    flipped header byte passes the payload-only check by construction).
+    No store artifacts cross versions in this repo (data dirs are
+    ephemeral test/drill state); a deployment upgrading live stores
+    would need a one-shot rewrite migration first."""
+    return zlib.crc32(payload, zlib.crc32(header17)) & 0xFFFFFFFF
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_LOCK = threading.Lock()
@@ -246,10 +268,10 @@ class SegmentStore:
                     raise OSError("segstore_append failed")
                 self._active_seg = seg.value
                 return seg.value, off.value
-            frame = _HEADER.pack(
-                _MAGIC, rec_type, slot, base, len(payload),
-                zlib.crc32(payload) & 0xFFFFFFFF,
-            ) + payload
+            hdr = _HEADER_PREFIX.pack(
+                _MAGIC, rec_type, slot, base, len(payload)
+            )
+            frame = hdr + _CRC.pack(_frame_crc(hdr, payload)) + payload
             if (
                 self._file.tell() + len(frame) > self.segment_bytes
                 and self._file.tell() > 0
@@ -286,10 +308,10 @@ class SegmentStore:
                     f"record payload of {len(payload)} bytes exceeds the "
                     f"1 GiB store record cap"
                 )
-            frames.append(_HEADER.pack(
-                _MAGIC, rec_type, slot, base, len(payload),
-                zlib.crc32(payload) & 0xFFFFFFFF,
-            ))
+            hdr = _HEADER_PREFIX.pack(
+                _MAGIC, rec_type, slot, base, len(payload)
+            )
+            frames.append(hdr + _CRC.pack(_frame_crc(hdr, payload)))
             frames.append(payload)
             rel.append(pos + _HEADER.size)
             pos += _HEADER.size + len(payload)
@@ -555,6 +577,123 @@ class SegmentStore:
                 self._erasure_worker()
 
 
+def verify_store(directory: str, repair_torn_tail: bool = False) -> int:
+    """Full CRC framing walk of a store directory; returns the record
+    count. Raises CorruptStoreError on any damage the torn-tail crash
+    contract does not cover:
+
+    - a corrupt record in a non-final segment (what the scanners refuse
+      at replay time), and
+    - a corrupt record in the FINAL segment that is FOLLOWED by valid
+      frames. The plain scanners cannot tell bit rot mid-file from a
+      torn tail — they stop and silently drop every acked record after
+      the damage; the look-ahead here upgrades that to quarantine-grade
+      corruption so recovery re-replicates instead of serving a
+      silently shortened history.
+
+    `repair_torn_tail=True` additionally TRUNCATES a tolerated torn
+    tail off the final segment (fsync'd). Both writers open a NEW
+    segment after the highest existing index, so an un-truncated torn
+    tail becomes the tail of a SEALED segment the moment the store
+    reopens — and every later scan refuses it as mid-store corruption
+    (the chaos proc drills hit exactly this: a phase-0 torn tail read
+    clean at that boot, then crash-looped the broker's next promotion).
+    The boot health gate must therefore repair what it tolerates.
+
+    This is the boot-time health gate behind quarantine: a broker must
+    know its store is fully servable BEFORE claiming any role that
+    serves from it, instead of crash-looping at its next promotion
+    (chaos disk-fault drills, ISSUE 4). Python framing by design — the
+    walk must analyze the damage, not just refuse at it."""
+    n = 0
+    files = list_segment_files(directory)
+    for fi, name in enumerate(files):
+        last_file = fi + 1 == len(files)
+        with open(os.path.join(directory, name), "rb") as f:
+            blob = f.read()
+        pos = 0
+        bad_at = None
+        while True:
+            if pos == len(blob):
+                break
+            if pos + _HEADER.size > len(blob):
+                bad_at = pos  # trailing partial header
+                break
+            magic, _t, _s, _b, length, crc = _HEADER.unpack(
+                blob[pos : pos + _HEADER.size]
+            )
+            if magic != _MAGIC or length > (1 << 30):
+                bad_at = pos
+                break
+            payload = blob[pos + _HEADER.size : pos + _HEADER.size + length]
+            if (len(payload) < length
+                    or _frame_crc(
+                        blob[pos : pos + _HEADER_PREFIX.size], payload
+                    ) != crc):
+                bad_at = pos
+                break
+            pos += _HEADER.size + length
+            n += 1
+        if bad_at is None:
+            continue
+        if not last_file:
+            raise CorruptStoreError(
+                f"corrupt record in sealed segment {name}"
+            )
+        if _valid_frame_after(blob, bad_at + 1):
+            raise CorruptStoreError(
+                f"corrupt record mid-{name}: valid records follow the "
+                f"damage at byte {bad_at} — bit rot, not a torn tail"
+            )
+        # True torn tail: tolerated (replay drops it).
+        if repair_torn_tail:
+            path = os.path.join(directory, name)
+            with open(path, "r+b") as f:
+                f.truncate(bad_at)
+                f.flush()
+                os.fsync(f.fileno())
+            _log.info("truncated torn tail of %s at byte %d", name, bad_at)
+    return n
+
+
+def _valid_frame_after(blob: bytes, start: int) -> bool:
+    """Whether any CRC-valid record frame begins at-or-after `start` —
+    the discriminator between a torn tail (nothing follows) and mid-file
+    corruption (acked records follow the damage)."""
+    magic = struct.pack("<I", _MAGIC)
+    pos = blob.find(magic, start)
+    while pos != -1:
+        if pos + _HEADER.size <= len(blob):
+            _m, _t, _s, _b, length, crc = _HEADER.unpack(
+                blob[pos : pos + _HEADER.size]
+            )
+            if (length <= (1 << 30)
+                    and pos + _HEADER.size + length <= len(blob)):
+                payload = blob[pos + _HEADER.size : pos + _HEADER.size + length]
+                if _frame_crc(
+                    blob[pos : pos + _HEADER_PREFIX.size], payload
+                ) == crc:
+                    return True
+        pos = blob.find(magic, pos + 1)
+    return False
+
+
+def quarantine_store(directory: str) -> str:
+    """Move a damaged store directory aside (`<dir>.quarantine-N`,
+    lowest unused N) and return the new path. The caller reopens a
+    fresh, empty store at `directory` and re-replicates through the
+    standby catch-up protocol; the damaged bytes are preserved for
+    forensics rather than deleted."""
+    n = 0
+    while True:
+        target = f"{directory}.quarantine-{n}"
+        if not os.path.exists(target):
+            break
+        n += 1
+    os.replace(directory, target)
+    return target
+
+
 def gc_floor(directory: str) -> int:
     """Lowest segment index deliberately retained after GC (0 if the
     store was never GC'd). Segments below this were DELETED on purpose,
@@ -665,7 +804,9 @@ def _scan_python_indexed(directory: str):
                     raise CorruptStoreError(f"absurd record length in {name}")
                 payload_off = f.tell()
                 payload = f.read(length)
-                if len(payload) < length or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                if (len(payload) < length
+                        or _frame_crc(hdr[:_HEADER_PREFIX.size], payload)
+                        != crc):
                     if last_file:
                         return  # torn/corrupt tail record
                     raise CorruptStoreError(f"bad record in {name}")

@@ -54,11 +54,14 @@ FAST_MODULES = {
     "test_model_check",
     "test_observability",
     "test_packaging",
+    "test_proc_chaos",          # ~2 min: 2-seed real-subprocess chaos smoke
     "test_process_cluster",     # ~20 s: real-subprocess broker boot
     "test_read_batching",
     "test_read_cache",
     "test_readme_bench",
     "test_settle_pipeline",
+    "test_settled_gap",
+    "test_term_skew",
     "test_retention",
     "test_retry_policy",
     "test_rs",
@@ -121,6 +124,7 @@ def test_known_soaks_stay_slow_marked():
     """The modules that took the seed's tier-1 over its timeout must
     keep their marks (deleting a mark reintroduces the timeout)."""
     for name in ("test_multihost", "test_soak_random", "test_soak_gc",
-                 "test_lockstep_drill", "test_chaos_soak"):
+                 "test_lockstep_drill", "test_chaos_soak",
+                 "test_proc_chaos_soak"):
         path = TESTS_DIR / f"{name}.py"
         assert _is_slow_marked(path), f"{name} lost its slow mark"

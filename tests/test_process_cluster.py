@@ -6,125 +6,53 @@ round trip). This boots 3 brokers via the actual CLI entry
 (`python -m ripplemq_tpu.broker`), round-trips produce→consume→commit
 through the client SDK over TCP, and runs the sample producer/consumer
 programs against the live cluster.
+
+The process plumbing itself (port allocation, config YAML, spawn/kill/
+restart) lives in `ripplemq_tpu.chaos.proc_cluster` — promoted there so
+the chaos plane can SIGKILL and disk-fault the same deployment shape;
+this module exercises the client-facing round trip over it.
 """
 
 from __future__ import annotations
 
 import os
-import socket
 import subprocess
 import sys
 import time
 
 import pytest
 
-import yaml
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_ports(n):
-    socks = []
-    try:
-        for _ in range(n):
-            s = socket.socket()
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
-
-
-def _write_config(tmp_path, ports):
-    cfg = {
-        "brokers": [
-            {"id": i, "host": "127.0.0.1", "port": p}
-            for i, p in enumerate(ports)
-        ],
-        "topics": [
-            {"name": "topic1", "partitions": 2, "replication_factor": 3},
-            {"name": "topic2", "partitions": 1, "replication_factor": 3},
-        ],
-        "engine": {
-            "partitions": 3, "replicas": 3, "slots": 256, "slot_bytes": 64,
-            "max_batch": 16, "read_batch": 16, "max_consumers": 16,
-            "max_offset_updates": 8,
-        },
-        "election_timeout_s": 0.5,
-        "metadata_election_timeout_s": 0.8,
-        "rpc_timeout_s": 5.0,
-    }
-    path = tmp_path / "cluster.yaml"
-    path.write_text(yaml.safe_dump(cfg))
-    return str(path)
 
 
 @pytest.fixture()
 def process_cluster(tmp_path):
-    ports = _free_ports(3)
-    config_path = _write_config(tmp_path, ports)
-    env = dict(
-        os.environ,
-        JAX_PLATFORMS="cpu",
-        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    from ripplemq_tpu.chaos.proc_cluster import (
+        ProcCluster,
+        free_ports,
+        make_proc_cluster_config,
     )
-    procs = []
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_proc_cluster_config(
+        free_ports(3),
+        topics=(Topic("topic1", 2, 3), Topic("topic2", 1, 3)),
+        metadata_election_timeout_s=0.8,
+    )
+    cluster = ProcCluster(config=config, data_dir=str(tmp_path / "data"))
+    cluster.start()
     try:
-        for i in range(3):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "ripplemq_tpu.broker",
-                 "--id", str(i), "--config", config_path,
-                 "--data-dir", str(tmp_path / "data")],
-                env=env, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            ))
-        yield {"ports": ports, "config": config_path, "env": env,
-               "procs": procs}
+        yield {"ports": [b.port for b in config.brokers],
+               "cluster": cluster, "env": cluster.env}
     finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
-
-
-def _wait_for_leaders(bootstrap, deadline_s=90.0):
-    """Poll metadata until every partition advertises a leader."""
-    from ripplemq_tpu.client.metadata import MetadataManager
-    from ripplemq_tpu.wire.transport import TcpClient
-
-    transport = TcpClient()
-    meta = MetadataManager(transport, bootstrap, refresh_interval_s=3600,
-                           rpc_timeout_s=2.0)
-    try:
-        deadline = time.monotonic() + deadline_s
-        while time.monotonic() < deadline:
-            try:
-                meta.refresh()
-                topics = [meta.topic("topic1"), meta.topic("topic2")]
-                if all(
-                    t is not None and t.assignments
-                    and all(a.leader is not None for a in t.assignments)
-                    for t in topics
-                ):
-                    return
-            except Exception:
-                pass
-            time.sleep(0.3)
-        raise AssertionError("cluster never elected leaders for all partitions")
-    finally:
-        meta.close()
-        transport.close()
+        cluster.stop()
 
 
 def test_three_process_tcp_roundtrip(process_cluster):
     from ripplemq_tpu.client import ConsumerClient, ProducerClient
 
     bootstrap = [f"127.0.0.1:{p}" for p in process_cluster["ports"]]
-    _wait_for_leaders(bootstrap)
+    process_cluster["cluster"].wait_for_leaders(timeout=90.0)
 
     producer = ProducerClient(bootstrap, metadata_refresh_s=1.0)
     consumer = ConsumerClient(bootstrap, "proc-consumer",

@@ -1,0 +1,271 @@
+"""Per-slot settled-gap reads (ISSUE 4 tentpole 1).
+
+The two residual windows ROADMAP carried since PR 2, now closed by the
+settled-gap structure (the mirror-gap analogue):
+
+1. A replication-FAILED round whose slot later settles a NEWER round sat
+   below the single `_settled_end` watermark and was readable from the
+   device ring — nacked data served as committed.
+2. After a ring wrap, the failed round's absolute range is a hole in the
+   store; boot replay then left the PREVIOUS lap's rows at those ring
+   positions and `install()` marked everything settled — a reader at the
+   hole got a different round's payloads at the wrong offsets.
+
+Both tests are directed failing-before/passing-after: they fail on the
+watermark design and pass with per-slot [begin, end) gaps that every
+read path (device ring, host mirror, store) skips and that promotion/
+boot replay rebuilds from the recovered store's coverage holes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from ripplemq_tpu.broker.dataplane import (
+    DataPlane,
+    NotCommittedError,
+    recover_image,
+)
+from ripplemq_tpu.broker.replication import ReplicationError
+from ripplemq_tpu.storage.segment import REC_APPEND, SegmentStore
+from tests.helpers import small_cfg
+
+
+class FailAtBaseReplicator:
+    """begin/wait replicator that acks instantly except rounds carrying
+    an append record at one of `bad_bases` — their wait raises a
+    TRANSIENT ReplicationError (standby loss mid-round, NOT a fencing
+    event, so later rounds keep settling)."""
+
+    def __init__(self, bad_bases) -> None:
+        self.bad_bases = set(bad_bases)
+        self.failed: list[int] = []
+        self._lock = threading.Lock()
+
+    def begin(self, records):
+        return records
+
+    def wait(self, ticket) -> None:
+        bad = [
+            rec[2] for rec in ticket
+            if rec[0] == REC_APPEND and rec[2] in self.bad_bases
+        ]
+        if bad:
+            with self._lock:
+                self.failed.extend(bad)
+            raise ReplicationError(
+                f"standby lost under round at base {bad} (injected)"
+            )
+
+    def replicate(self, records) -> None:
+        self.wait(self.begin(records))
+
+
+def _attach(dp: DataPlane, rep) -> DataPlane:
+    dp.replicate_fn = rep.replicate
+    dp.replicate_begin_fn = rep.begin
+    dp.replicate_wait_fn = rep.wait
+    dp.start()
+    dp.set_leader(0, 0, 1)
+    return dp
+
+
+def _read_all(dp: DataPlane, slot: int = 0, start: int = 0):
+    """Walk the full readable log; returns (messages, offsets_seen)."""
+    msgs, offs, offset = [], [], start
+    for _ in range(1000):
+        got, nxt = dp.read(slot, offset, replica=0)
+        for m in got:
+            msgs.append(m)
+        offs.append((offset, nxt, list(got)))
+        if nxt == offset:
+            return msgs, offs
+        offset = nxt
+    raise AssertionError(f"read walk never terminated: {offs[-5:]}")
+
+
+def test_failed_round_below_later_settled_round_is_not_readable():
+    """Residual window 1: round 2 of a slot fails replication (nacked to
+    its producer), round 3 settles. The settled horizon passes the
+    failed round — its rows must NOT be served by any read path."""
+    rep = FailAtBaseReplicator(bad_bases={8})
+    dp = _attach(
+        DataPlane(small_cfg(partitions=2), mode="local", coalesce_s=0.0),
+        rep,
+    )
+    try:
+        assert dp.submit_append(0, [b"ok-1"]).result(timeout=10) == 0
+        bad = dp.submit_append(0, [b"BAD-1", b"BAD-2"])
+        with pytest.raises(NotCommittedError):
+            bad.result(timeout=10)
+        assert rep.failed == [8]
+        assert dp.submit_append(0, [b"ok-2"]).result(timeout=10) == 16
+        # The horizon passed the gap (round 3 settled at [16, 24)).
+        assert dp.settled_end(0) == 24
+        assert dp.settled_gap_slots() == 1
+        msgs, offs = _read_all(dp)
+        assert b"BAD-1" not in msgs and b"BAD-2" not in msgs, (
+            f"nacked rows served below a later settled round: {offs}"
+        )
+        assert msgs == [b"ok-1", b"ok-2"]
+        # Reading INSIDE the gap walks past it within ONE call and
+        # serves the next settled round (consumers only advance their
+        # committed offset on delivered batches, so an empty-but-
+        # advanced answer would strand them below the gap forever).
+        got, nxt = dp.read(0, 8, replica=0)
+        assert got == [b"ok-2"] and nxt == 24
+    finally:
+        dp.stop()
+
+
+def test_failed_round_gap_survives_ring_wrap_and_boot_replay(tmp_path):
+    """Residual window 2: the failed round's range becomes a store HOLE;
+    after a ring wrap its device rows are recycled and boot replay fills
+    its ring positions with the PREVIOUS lap's record. Neither the live
+    plane nor a restarted one may serve the nacked rows — or another
+    round's payloads at the gap's offsets."""
+    cfg = small_cfg(partitions=2)  # slots=64, max_batch=8
+    d = str(tmp_path / "store")
+    rep = FailAtBaseReplicator(bad_bases={72})
+    store = SegmentStore(d, use_native=False)
+    dp = _attach(
+        DataPlane(cfg, mode="local", store=store, flush_interval_s=0.0,
+                  coalesce_s=0.0),
+        rep,
+    )
+    expect: list[bytes] = []
+    try:
+        for i in range(12):  # bases 0..88; base 72 fails, ring wraps at 64
+            batch = [b"r%02d-%d" % (i, j) for j in range(8)]
+            fut = dp.submit_append(0, batch)
+            if i == 9:  # base 72: replication fails, producer nacked
+                with pytest.raises(NotCommittedError):
+                    fut.result(timeout=10)
+            else:
+                assert fut.result(timeout=10) == i * 8
+                expect.extend(batch)
+        assert rep.failed == [72]
+        assert dp.settled_end(0) == 96
+        msgs, offs = _read_all(dp)
+        assert not any(m.startswith(b"r09-") for m in msgs), (
+            f"nacked rows of the wrapped failed round served: {offs}"
+        )
+        assert msgs == expect, f"wrong rows through the gap: {offs}"
+    finally:
+        dp.stop()
+        store.close()
+
+    # Restart: boot replay must rebuild the gap from the store's coverage
+    # hole — without it, ring positions 8..16 (= 72 % 64) still hold the
+    # lap-0 round at base 8 and a reader at offset 72 gets r01-* payloads
+    # at the wrong offsets.
+    gaps: dict = {}
+    image = recover_image(cfg, d, gaps_out=gaps)
+    assert image is not None
+    store2 = SegmentStore(d, use_native=False)
+    dp2 = DataPlane(cfg, mode="local", store=store2, flush_interval_s=0.0)
+    dp2.install(image, settled_gaps=gaps)
+    dp2.start()
+    try:
+        assert dp2.settled_gap_slots() == 1
+        got, nxt = dp2.read(0, 72, replica=0)
+        assert got and not any(m.startswith(b"r09-") for m in got), (
+            f"boot replay served rows inside the settled gap: {got!r}"
+        )
+        assert all(m.startswith(b"r10-") for m in got), (
+            f"wrong-lap rows at the gap's offsets: {got!r}"
+        )
+        msgs, offs = _read_all(dp2)
+        assert msgs == expect, f"recovered log diverges: {offs}"
+    finally:
+        dp2.stop()
+        store2.close()
+
+
+def test_long_poll_parks_past_empty_but_advanced_read():
+    """A long-poll parked below an all-padding tail (or a settled gap)
+    must arm its wake watermark on the read's ADVANCE, not the caller's
+    offset: the pre-fix loop re-read the same empty-but-advanced answer
+    every 10 ms tick for the whole window (settled_end sat permanently
+    above the parked offset), ~1000 wasted reads per consume. Post-fix
+    the park stays quiet until rows settle PAST the advance — and still
+    delivers them, and still hands the advance back at window expiry so
+    the consumer can commit across the dead range."""
+    import threading as _threading
+    import time as _time
+
+    from ripplemq_tpu.metadata.models import Topic
+    from tests.broker_harness import InProcCluster, make_config
+
+    config = make_config(
+        n_brokers=3,
+        topics=(Topic("t", 1, 3),),
+        engine=small_cfg(partitions=1, replicas=3, slots=256),
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        ctrl_id = next(iter(c.brokers.values())).manager.current_controller()
+        ctrl = c.brokers[ctrl_id]
+        dp = ctrl.dataplane
+        client = c.net.client("lp-gap")
+        leader = ctrl.manager.leader_of(("t", 0))
+
+        def produce(payload):
+            resp = client.call(
+                c.brokers[leader].addr,
+                {"type": "produce", "topic": "t", "partition": 0,
+                 "messages": [payload]},
+                timeout=5.0,
+            )
+            assert resp.get("ok"), resp
+
+        produce(b"m0")  # settles [0, 8): rows 1..7 are padding
+        reads = []
+        real_read = dp.read
+        dp.read = lambda *a, **kw: (reads.append(a), real_read(*a, **kw))[1]
+        try:
+            # Idle tail: the park must not spin on the padding advance.
+            msgs, end = ctrl._engine_read(0, 1, 0, None, wait_s=1.5)
+            assert msgs == [] and end == 8, (msgs, end)
+            assert len(reads) <= 3, (
+                f"parked long-poll re-read {len(reads)}x in 1.5 s"
+            )
+            # Armed park: rows settling past the advance wake and serve.
+            del reads[:]
+            out = {}
+
+            def park():
+                out["res"] = ctrl._engine_read(0, 1, 0, None, wait_s=8.0)
+
+            t = _threading.Thread(target=park)
+            t.start()
+            _time.sleep(0.4)  # parked on the padding tail
+            produce(b"m1")
+            t.join(timeout=8.0)
+            assert not t.is_alive(), "long-poll never woke on settle"
+            msgs, end = out["res"]
+            assert msgs == [b"m1"] and end == 16, out["res"]
+        finally:
+            dp.read = real_read
+
+
+def test_gap_recorded_even_when_nothing_later_settles():
+    """A settle failure with no later settled round: the horizon never
+    passes the gap, reads stay clamped — and the gap bookkeeping alone
+    must not corrupt the tail poll (empty reads at the horizon)."""
+    rep = FailAtBaseReplicator(bad_bases={0})
+    dp = _attach(DataPlane(small_cfg(partitions=2), mode="local",
+                           coalesce_s=0.0), rep)
+    try:
+        with pytest.raises(NotCommittedError):
+            dp.submit_append(0, [b"BAD"]).result(timeout=10)
+        assert dp.settled_end(0) == 0
+        got, nxt = dp.read(0, 0, replica=0)
+        assert got == []
+        # Whether the read clamps at the horizon (0) or skips the gap
+        # (8), it must never serve the nacked row.
+        assert nxt in (0, 8)
+    finally:
+        dp.stop()

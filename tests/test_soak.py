@@ -106,12 +106,37 @@ def _drain(c, client, topic, pid, consumer, dead=(), deadline_s=120.0):
         got.extend(msgs)
         if msgs:
             quiet = 0
-            client.call(
-                c.brokers[leader].addr,
-                {"type": "offset.commit", "topic": topic, "partition": pid,
-                 "consumer": consumer, "offset": resp["next_offset"]},
-                timeout=5.0,
-            )
+            # Drive the commit to an ACKED success before the next
+            # consume. A transiently refused commit (leadership or the
+            # settle horizon still catching up post-recovery) means the
+            # next consume legally re-serves the batch — at-least-once —
+            # but these drains assert EXACT delivery, so swallowing the
+            # refusal reads as a duplicate (observed: cold-restart drain
+            # re-served its first batch under tier-1 host contention).
+            while True:
+                assert time.time() < deadline, (
+                    f"offset.commit of {topic}[{pid}] never acked after "
+                    f"{deadline_s}s ({len(got)} messages drained)"
+                )
+                live = [b for i, b in c.brokers.items() if i not in dead]
+                leader = live[0].manager.leader_of((topic, pid))
+                if leader is None or leader in dead:
+                    time.sleep(0.05)
+                    continue
+                try:
+                    ack = client.call(
+                        c.brokers[leader].addr,
+                        {"type": "offset.commit", "topic": topic,
+                         "partition": pid, "consumer": consumer,
+                         "offset": resp["next_offset"]},
+                        timeout=5.0,
+                    )
+                except Exception:
+                    time.sleep(0.05)
+                    continue
+                if ack.get("ok"):
+                    break
+                time.sleep(0.05)
         else:
             quiet += 1
             time.sleep(0.02)
