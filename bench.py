@@ -751,9 +751,40 @@ def e2e_raw_config(ports: list[int], partitions: int = 1024) -> dict:
     }
 
 
+# The stage histograms that make up the host-path decomposition
+# (PROFILE.md "host path") — each produce ack's time, attributed live by
+# the telemetry plane instead of hand-profiled: device launch, launch →
+# committed fetch, commit → settle-window entry, the standby-ack
+# barrier, local persist (with store append/fsync below it), and the
+# whole dispatch → ack-release round trip; plus the batching factors
+# (chain rounds per dispatch, replication rounds per group-commit frame).
+_DECOMPOSITION_STAGES = (
+    "engine.dispatch_us",
+    "settle.commit_wait_us",
+    "settle.enter_wait_us",
+    "settle.standby_ack_us",
+    "settle.persist_us",
+    "settle.release_us",
+    "store.append_us",
+    "store.fsync_us",
+    "repl.frame_us",
+    "repl.group_rounds",
+    "engine.chain_rounds",
+)
+
+
+def _latency_decomposition(metrics_snapshot: dict) -> dict:
+    """The per-stage summaries (count/mean/p50/p90/p99/max, integer
+    microseconds for the *_us stages) pulled out of an admin.metrics
+    snapshot — the live-measured version of PROFILE.md's host-path
+    table."""
+    hists = metrics_snapshot.get("histograms", {})
+    return {k: hists[k] for k in _DECOMPOSITION_STAGES if k in hists}
+
+
 def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
              threads: int = 8, batch: int = 512, window: int = 16,
-             phases: int = 2) -> dict:
+             phases: int = 2, obs: bool = True) -> dict:
     """END-TO-END produce throughput: fresh, distinct payloads streamed
     by real producer clients through TCP sockets, broker dispatch, the
     DataPlane batcher, device quorum rounds, the round store, AND the
@@ -800,6 +831,7 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
 
     partitions = 1024
     raw = e2e_raw_config(ports, partitions)
+    raw["obs"] = obs  # telemetry A/B knob (PROFILE.md overhead table)
     tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
     config = parse_cluster_config(raw)
     brokers = []
@@ -1028,7 +1060,19 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
         cc.close()
 
         settle = dp.settle_stats()
+        # End-of-run telemetry snapshot: the BENCH_r*.json artifact
+        # carries the full decomposition, not just totals — the obs
+        # plane's metrics are the same admin.metrics every broker serves.
+        from ripplemq_tpu.wire import codec as _codec
+
+        metrics_snap = controller.metrics.snapshot()
         return {
+            "e2e_obs": obs,
+            "latency_decomposition": _latency_decomposition(metrics_snap),
+            "admin_metrics": {
+                "metrics": metrics_snap,
+                "wire": _codec.codec_stats(),
+            },
             "e2e_appends_per_sec": round(best_produce[0], 1),
             "e2e_mb_per_sec": round(best_produce[1], 2),
             "e2e_acked": acked_total,

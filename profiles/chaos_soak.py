@@ -49,6 +49,14 @@ def main() -> int:
                          "(torn tail / bit flip / lost sealed segment) "
                          "instead of in-proc network faults; identical "
                          "JSON verdict schema")
+    ap.add_argument("--timeline", action="store_true",
+                    help="attach the merged fault-vs-lifecycle timeline "
+                         "(nemesis fault ops + every broker's flight-"
+                         "recorder events, sorted by wall clock) even on "
+                         "clean runs; violating runs always carry it")
+    ap.add_argument("--postmortems", action="store_true",
+                    help="attach per-broker admin.postmortem bundles even "
+                         "on clean runs; violating runs always carry them")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON file holding a recorded trace (or a full "
                          "verdict) to re-apply instead of generating "
@@ -96,6 +104,8 @@ def main() -> int:
             ops_per_phase=args.ops_per_phase,
             schedule=schedule,
             backend=args.backend,
+            include_timeline=args.timeline,
+            include_postmortems=args.postmortems,
             # Process boots (JAX import + XLA compiles per broker) put
             # convergence probes on a different clock than in-proc runs.
             converge_timeout_s=120.0 if args.backend == "proc" else 30.0,

@@ -46,6 +46,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--log-level", default="INFO",
                     help="console log level for the ripplemq loggers "
                          "(DEBUG/INFO/WARNING/ERROR)")
+    ap.add_argument("--log-json", action="store_true",
+                    help="emit one JSON object per log line (ts/level/"
+                         "subsystem/broker/thread/msg) instead of the "
+                         "log4j2-style pattern — machine-greppable next "
+                         "to the telemetry plane's event timeline")
     ap.add_argument("--coordinator", default=None,
                     help="multi-host SPMD: host 0's host:port for "
                          "jax.distributed (run the controller with "
@@ -67,7 +72,8 @@ def main(argv: list[str] | None = None) -> int:
     from ripplemq_tpu.metadata.cluster_config import load_cluster_config
     from ripplemq_tpu.utils.logs import configure_logging
 
-    configure_logging(args.log_level)
+    configure_logging(args.log_level, json_lines=args.log_json,
+                      broker_id=args.broker_id)
 
     if args.coordinator is not None:
         # Join the global mesh BEFORE any other JAX use: after this,

@@ -154,8 +154,39 @@ class DataPlane:
         settle_window: Optional[int] = None,
         read_coalesce_s: float = 0.001,
         durability: str = "async",
+        obs: bool = True,
+        metrics=None,
+        recorder=None,
     ) -> None:
         self.cfg = cfg
+        # --- telemetry plane (obs/) ---------------------------------------
+        # `metrics`/`recorder` are normally the OWNING BrokerServer's (one
+        # registry + one flight-recorder ring per broker, wired through at
+        # boot); a bare plane (tests, benches) builds its own. `obs=False`
+        # swaps in no-op metrics — the A/B knob — while the flight
+        # recorder stays on (its per-ROUND cost is a few hundred ns and
+        # its whole value is being on when nobody expected to need it).
+        from ripplemq_tpu.obs.metrics import Metrics
+        from ripplemq_tpu.obs.trace import FlightRecorder
+
+        self.metrics = metrics if metrics is not None else Metrics(enabled=obs)
+        self.recorder = recorder if recorder is not None else FlightRecorder()
+        m = self.metrics
+        # Hot-path metric handles resolved ONCE (registry lookups lock).
+        self._m_submits = m.counter("produce.submits")
+        self._m_messages = m.counter("produce.messages")
+        self._m_offsets = m.counter("produce.offset_commits")
+        self._m_dispatch_us = m.histogram("engine.dispatch_us")
+        self._m_chain_rounds = m.histogram("engine.chain_rounds")
+        self._m_commit_wait_us = m.histogram("settle.commit_wait_us")
+        self._m_enter_wait_us = m.histogram("settle.enter_wait_us")
+        self._m_standby_ack_us = m.histogram("settle.standby_ack_us")
+        self._m_persist_us = m.histogram("settle.persist_us")
+        self._m_release_us = m.histogram("settle.release_us")
+        self._m_retries = m.counter("produce.round_retries")
+        self._m_retry_exhausted = m.counter("produce.retry_exhausted")
+        self._m_read_calls = m.counter("read.calls")
+        self._m_read_msgs = m.counter("read.messages")
         # Durability mode for the settle-path persist: "async" defers
         # fsync to the store's flusher thread at flush_interval_s cadence
         # (disk lags acks by at most one interval — the PR 3 contract);
@@ -528,6 +559,8 @@ class DataPlane:
             # no-commit streak so a just-healed term skew doesn't keep
             # re-triggering elections before the next round lands.
             self._nocommit_streak.pop(slot, None)
+        self.recorder.record("set_leader", slot=int(slot),
+                             leader=int(leader_slot), term=int(term))
 
     def set_alive(self, alive: np.ndarray) -> None:
         """Install a new [P, R] per-partition replica liveness mask."""
@@ -606,7 +639,9 @@ class DataPlane:
         on a healthy idle cluster. Fresh failing dispatches re-build the
         streak, so a real skew appearing later still trips the probe."""
         with self._lock:
-            self._nocommit_streak.pop(slot, None)
+            had = self._nocommit_streak.pop(slot, None)
+        if had is not None:
+            self.recorder.record("stall_reset", slot=int(slot), streak=had)
 
     def _add_settled_gap_locked(self, slot: int, begin: int,
                                 end: int) -> None:
@@ -621,6 +656,9 @@ class DataPlane:
             gaps[-1][1] = max(gaps[-1][1], end)
         else:
             gaps.append([begin, end])
+        # Recorder appends are lock-free — safe under the plane's lock.
+        self.recorder.record("settled_gap", slot=int(slot),
+                             begin=int(begin), end=int(end))
 
     def _gap_clamp_locked(self, slot: int, offset: int,
                           count: int) -> tuple[Optional[int], int]:
@@ -765,6 +803,8 @@ class DataPlane:
                 TypeError(f"payloads must be bytes: {e}")
             )
             return fut
+        self._m_submits.inc()
+        self._m_messages.inc(len(payloads))
         with self._lock:
             if self._log_end[slot] >= _OFFSET_HORIZON:
                 fut.set_exception(
@@ -805,6 +845,7 @@ class DataPlane:
         if not updates or any(not 0 <= s < C for s, _ in updates):
             fut.set_exception(ValueError(f"bad consumer slots in {updates}"))
             return fut
+        self._m_offsets.inc()
         with self._lock:
             self._offsets.setdefault(slot, []).append(
                 _PendingOffsets([(int(s), int(o)) for s, o in updates], fut,
@@ -845,6 +886,7 @@ class DataPlane:
         commit, which no read path ever serves)."""
         if not 0 <= slot < self.cfg.partitions:
             raise ValueError(f"partition slot {slot} out of range")
+        self._m_read_calls.inc()
         gc_races = 0
         while True:
             with self._lock:
@@ -883,6 +925,7 @@ class DataPlane:
                         # reach the caller while rows remain.
                         offset = nxt_got
                         continue
+                    self._m_read_msgs.inc(len(msgs_got))
                     return got
                 # Nothing persisted at-or-after `offset` (store GC can
                 # reclaim a partition's entire below-trim history):
@@ -899,6 +942,7 @@ class DataPlane:
                         offset = nxt_res  # all-padding window: keep walking
                         continue
                     self.read_cache_hits += 1
+                    self._m_read_msgs.inc(len(msgs_res))
                     return res
             fut: Future = Future()
             with self._read_lock:
@@ -949,6 +993,7 @@ class DataPlane:
             next_offset = offset + (with_pos[-1][0] + 1 if with_pos else 0)
         else:
             next_offset = offset + count
+        self._m_read_msgs.inc(len(with_pos))
         return [m for _, m in with_pos], next_offset
 
     def _read_cache(
@@ -1346,7 +1391,13 @@ class DataPlane:
                 self._adopt_lockstep_state(e)
                 raise
             elected = np.asarray(elected)
-        return {slot: bool(elected[slot]) for slot in candidates}
+        out = {slot: bool(elected[slot]) for slot in candidates}
+        self.recorder.record(
+            "elect", candidates=len(candidates),
+            won=sum(1 for w in out.values() if w),
+            slots=[int(s) for s in sorted(candidates)][:32],
+        )
+        return out
 
     def resync(self, src_slot: int, dst_slot: int, partitions: list[int]) -> None:
         """Copy `src_slot`'s replica state over `dst_slot` for the given
@@ -1676,6 +1727,7 @@ class DataPlane:
                     self._work.wait(timeout=0.02)
                     continue
                 inp, ctx = work
+                t_dispatch = self.metrics.clock()
                 with self._device_lock:
                     try:
                         if len(ctx["chain"]) == 1:
@@ -1694,9 +1746,22 @@ class DataPlane:
                         self._adopt_lockstep_state(e)
                         raise
                 self.dispatches += 1
-                self.rounds += sum(
+                live_rounds = sum(
                     1 for rc in ctx["chain"]
                     if rc["appends"] or rc["offsets"]
+                )
+                self.rounds += live_rounds
+                # Stage 1 of the round-lifecycle decomposition: the
+                # (async) device launch call. Stamp t_dispatch in the
+                # ctx so the downstream stages (commit fetch, settle
+                # entry, acks, persist, release) measure against it.
+                self._m_dispatch_us.observe(self.metrics.clock() - t_dispatch)
+                self._m_chain_rounds.observe_int(live_rounds)
+                ctx["t_dispatch"] = t_dispatch
+                self.recorder.record(
+                    "dispatch", round_seq=self._dispatch_seq,
+                    rounds=live_rounds,
+                    slots=len(ctx["appends"]) + len(ctx["offsets"]),
                 )
                 start_async = getattr(out.committed, "copy_to_host_async",
                                       None)
@@ -1759,9 +1824,23 @@ class DataPlane:
         entry = None
         try:
             committed = np.asarray(out.committed)  # the ONE device fetch
+            # Stage 2: dispatch → committed-fetch landed (device execute
+            # + D2H). Wall time since the launch, so queueing behind
+            # other dispatches is IN the number — this is the latency a
+            # producer's round actually experiences.
+            ctx["t_commit"] = self.metrics.clock()
+            self._m_commit_wait_us.observe(ctx["t_commit"]
+                                           - ctx["t_dispatch"])
             if committed.ndim == 1:
                 committed = committed[None]  # single round as a 1-chain
             chain = ctx["chain"]
+            n_committed = sum(
+                1
+                for k, rc in enumerate(chain)
+                for slot in set(rc["appends"]) | set(rc["offsets"])
+                if committed[k, slot]
+            )
+            self.recorder.record("commit", round_seq=seq, committed=n_committed)
             records = []
             for k, rc in enumerate(chain):
                 records.extend(self._round_records(rc, committed[k]))
@@ -1835,6 +1914,15 @@ class DataPlane:
             with self._lock:
                 self.settle_backpressure += 1
             self._settle_sem.acquire()
+        # Stage 3: commit → settle-window entry (turnstile ordering +
+        # window backpressure). A growing number here with a small
+        # commit_wait means the standbys, not the device, are the wall.
+        t_enter = self.metrics.clock()
+        ctx["t_enter"] = t_enter
+        self._m_enter_wait_us.observe(t_enter - ctx.get("t_commit", t_enter))
+        self.recorder.record("settle_enter", round_seq=ctx["seq"],
+                             records=len(records),
+                             depth=self._settle_q.qsize())
         ticket = exc = None
         if records and self.replicate_begin_fn is not None:
             try:
@@ -1894,13 +1982,23 @@ class DataPlane:
             # the round everywhere EXCEPT the standby stores, whose
             # replay is later-record-wins — the retry's re-append at the
             # same base supersedes the orphaned copy.
+            t_wait = self.metrics.clock()
             if ticket is not None:
                 self.replicate_wait_fn(ticket)
             elif records and self.replicate_fn is not None:
                 # No begin/wait split available (plain replicate_fn):
                 # synchronous, still strictly in release order.
                 self.replicate_fn(records)
+            # Stage 4: the standby-ack barrier as the settle thread
+            # experiences it (overlap with the pipelined stream means
+            # this can be ~0 even when the RPC itself took longer —
+            # repl.frame_us has the raw sender-side number).
+            t_acked = self.metrics.clock()
+            self._m_standby_ack_us.observe(t_acked - t_wait)
             self._persist_round(records)
+            # Stage 5: local persist (store framing + any strict-mode
+            # inline fsync; store.append_us/fsync_us decompose further).
+            self._m_persist_us.observe(self.metrics.clock() - t_acked)
             # ---- DURABLY SETTLED from here: the round is persisted AND
             # standby-acked. Only now may readers see its effects —
             # mirror rows (the _cache_end advance admits cache readers),
@@ -1936,6 +2034,12 @@ class DataPlane:
             for k in range(len(chain) - 1, -1, -1):
                 self._settle_round(chain[k], chain[k]["bases"],
                                    committed[k], ack=True)
+            # Stage 6 (the whole-round number): dispatch → ack release.
+            t0 = ctx.get("t_dispatch")
+            if t0 is not None:
+                self._m_release_us.observe(self.metrics.clock() - t0)
+            self.recorder.record("settle_release", round_seq=ctx["seq"],
+                                 records=len(records))
         except Exception as e:
             from ripplemq_tpu.broker.replication import FencedError
 
@@ -1960,6 +2064,9 @@ class DataPlane:
                                 rc["bases"][slot] + adv,
                             )
             log.warning("round settle error: %s: %s", type(e).__name__, e)
+            self.recorder.record("settle_fail", round_seq=ctx.get("seq", -1),
+                                 error=f"{type(e).__name__}: {e}"[:200],
+                                 fenced=self._settle_fenced)
             self._fail_committed(ctx, committed, e)
         finally:
             self._settle_sem.release()
@@ -2119,6 +2226,11 @@ class DataPlane:
             self._offsets_shadow = np.asarray(image.offsets, np.int32).copy()
         with self._device_lock:
             self._state = self.fns.init_from(image)
+        self.recorder.record(
+            "install", partitions_with_data=int((ends > 0).sum()),
+            max_log_end=int(ends.max()),
+            gap_slots=len(self._settled_gaps),
+        )
         log.info("installed recovered image: %d partitions with data, "
                  "max log end %d", int((ends > 0).sum()), int(ends.max()))
 
@@ -2187,6 +2299,98 @@ class DataPlane:
                 "backpressure_waits": self.settle_backpressure,
             }
 
+    def postmortem(self) -> dict:
+        """The engine section of a postmortem bundle (obs/postmortem.py):
+        the PR 4 term-skew cross-section — control tables vs device
+        scalars in ONE snapshot — plus stall streaks, settled gaps,
+        settle-window occupancy, degradation, and retry budgets. All
+        wire-encodable (str keys, plain ints/lists).
+
+        One device-lock hold spanning three leaf fetches (terms,
+        commits, log ends — under lockstep, three broadcast calls): a
+        one-shot diagnosis RPC, not a polling surface — on a busy plane
+        the fetches wait out the dispatch pipeline exactly like any
+        other state fetch (see busy()), so expect the RPC to stall up
+        to a few dispatch drains on a loaded broker. A FAILING
+        fetch (broken lockstep plane — exactly a state this bundle
+        exists to diagnose) degrades to a host-only bundle with
+        `device_error` set instead of losing the control tables, stall
+        streaks, and gaps that never needed the device."""
+        device_error = None
+        P = self.cfg.partitions
+        try:
+            with self._device_lock:
+                dev_terms = self._fetch_state("current_term").max(axis=0)
+                dev_commit = self._fetch_state("commit").max(axis=0)
+                dev_ends = self._fetch_state("log_end").max(axis=0)
+        except Exception as e:
+            device_error = f"{type(e).__name__}: {e}"[:200]
+            dev_terms = np.full((P,), -1, np.int64)
+            dev_commit = np.full((P,), -1, np.int64)
+            dev_ends = np.full((P,), -1, np.int64)
+        with self._lock:
+            leader = self.leader.copy()
+            term = self.term.copy()
+            host_end = self._log_end.copy()
+            settled = self._settled_end.copy()
+            persisted = self._persisted.copy()
+            trim = self.trim.copy()
+            streaks = dict(self._nocommit_streak)
+            gaps = {
+                int(s): [[int(b), int(e)] for b, e in v]
+                for s, v in self._settled_gaps.items() if v
+            }
+        # The wedge signature, precomputed: the control table advertises
+        # a term BEHIND what the device granted — every dispatch at the
+        # table's term is refused, commits freeze, the leader looks
+        # healthy. (PR 4: ctrl_table_term=[5,5] vs device=[8,8].) With
+        # the device unreachable (-1 sentinels) no slot reads skewed.
+        skew = [
+            int(s) for s in range(self.cfg.partitions)
+            if int(dev_terms[s]) > int(term[s])
+        ]
+        return {
+            "partitions": self.cfg.partitions,
+            "device_error": device_error,
+            "ctrl_table": {
+                "leader": [int(x) for x in leader],
+                "term": [int(x) for x in term],
+            },
+            "device_current_terms": [int(x) for x in dev_terms],
+            "device_commit": [int(x) for x in dev_commit],
+            "device_log_ends": [int(x) for x in dev_ends],
+            "host_log_end": [int(x) for x in host_end],
+            "settled_end": [int(x) for x in settled],
+            "persisted": [int(x) for x in persisted],
+            "trim": [int(x) for x in trim],
+            "term_skew_slots": skew,
+            "stall_streaks": {str(s): int(n) for s, n in streaks.items()},
+            "stalled_slots": self.stalled_slots(),
+            "settled_gaps": {str(s): v for s, v in gaps.items()},
+            "mirror_gap_slots": self.mirror_gap_slots(),
+            "settle": self.settle_stats(),
+            "degraded_slots": self.degraded_slots(),
+            "retry_budget": {
+                "max_retry_rounds": self.max_retry_rounds,
+                "pipeline_depth": self.pipeline_depth,
+                "chain_depth": self.chain_depth,
+                "settle_window": self.settle_window,
+                "round_retries": self._m_retries.n
+                if hasattr(self._m_retries, "n") else 0,
+                "retry_exhausted": self._m_retry_exhausted.n
+                if hasattr(self._m_retry_exhausted, "n") else 0,
+            },
+            "counters": {
+                "rounds": self.rounds,
+                "dispatches": self.dispatches,
+                "committed_entries": self.committed_entries,
+                "step_errors": self.step_errors,
+                "read_queries": self.read_queries,
+                "read_dispatches": self.read_dispatches,
+                "read_cache_hits": self.read_cache_hits,
+            },
+        }
+
     def _settle_round(self, ctx, base: dict, committed, ack: bool) -> None:
         """One round's future settlement, in two phases. `ack=False`
         (resolver, slots still busy): nack/requeue the round's
@@ -2253,6 +2457,7 @@ class DataPlane:
                             )
                         )
                 elif pend.rounds_left <= 0:
+                    self._m_retry_exhausted.inc()
                     if not pend.future.done():
                         pend.future.set_exception(
                             NotCommittedError(
@@ -2302,6 +2507,7 @@ class DataPlane:
             for pend in taken_off:
                 pend.rounds_left -= 1
                 if pend.rounds_left <= 0:
+                    self._m_retry_exhausted.inc()
                     if not pend.future.done():  # caller may cancel()
                         pend.future.set_exception(
                             NotCommittedError(
@@ -2311,6 +2517,7 @@ class DataPlane:
                 else:
                     requeue_o.append((slot, pend))
         if requeue_a or requeue_o:
+            self._m_retries.inc(len(requeue_a) + len(requeue_o))
             with self._lock:
                 for slot, pend in reversed(requeue_a):
                     self._appends.setdefault(slot, []).insert(0, pend)

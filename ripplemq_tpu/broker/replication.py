@@ -237,6 +237,8 @@ class _Sender(threading.Thread):
                         FencedError("controller deposed (local metadata)")
                     )
                     break
+                rep_h = self._rep._h_frame_us
+                t_frame = self._rep._clock() if rep_h is not None else 0.0
                 try:
                     resp = self._rep.client.call(
                         self._rep.addr_of(self.broker_id),
@@ -251,6 +253,8 @@ class _Sender(threading.Thread):
                     )
                 except RpcError:
                     failures += 1
+                    if self._rep._c_retries is not None:
+                        self._rep._c_retries.inc()
                     if failures >= 3:
                         self.unreachable = True
                     time.sleep(min(0.5, backoff * failures))
@@ -258,6 +262,17 @@ class _Sender(threading.Thread):
                 failures = 0
                 self.unreachable = False
                 if resp.get("ok"):
+                    # Group-commit telemetry: rounds per acked frame is
+                    # the batching factor the PR 3 sender bought; the
+                    # frame RPC time is the raw standby round trip the
+                    # settle stage's standby_ack_us overlaps away.
+                    if self._rep._h_group is not None:
+                        self._rep._h_group.observe_int(len(futs))
+                        self._rep._h_frame_us.observe(
+                            self._rep._clock() - t_frame
+                        )
+                        self._rep._c_records.inc(len(records))
+                        self._rep._c_frames.inc()
                     log.debug("standby %d acked %d records (%d rounds) at "
                               "epoch %d", self.broker_id, len(records),
                               len(futs), epoch)
@@ -299,6 +314,7 @@ class RoundReplicator:
         active_fn: Callable[[], bool],
         rpc_timeout_s: float = 3.0,
         ack_timeout_s: float = 5.0,
+        metrics=None,
     ) -> None:
         self.client = client
         self.addr_of = addr_of
@@ -307,6 +323,20 @@ class RoundReplicator:
         self.active = active_fn
         self.rpc_timeout_s = rpc_timeout_s
         self.ack_timeout_s = ack_timeout_s
+        # Sender-side group-commit telemetry (obs.Metrics, usually the
+        # owning broker's registry). None or a disabled registry → the
+        # handles stay None and the send loop skips the clock reads too.
+        if metrics is not None and getattr(metrics, "enabled", True):
+            self._h_group = metrics.histogram("repl.group_rounds")
+            self._h_frame_us = metrics.histogram("repl.frame_us")
+            self._c_records = metrics.counter("repl.records")
+            self._c_frames = metrics.counter("repl.frames")
+            self._c_retries = metrics.counter("repl.send_retries")
+            self._clock = metrics.clock
+        else:
+            self._h_group = self._h_frame_us = None
+            self._c_records = self._c_frames = self._c_retries = None
+            self._clock = time.perf_counter
         self._lock = threading.Lock()
         self._senders: dict[int, _Sender] = {}
         self._joining: set[int] = set()

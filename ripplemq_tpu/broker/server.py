@@ -154,6 +154,23 @@ class BrokerServer:
         self.broker_id = broker_id
         self.config = config
         self.info = config.broker(broker_id)
+        # --- telemetry plane (obs/): one metrics registry + one flight-
+        # recorder ring per broker, created FIRST so every layer below
+        # (store, replicator, data plane) threads through the same pair.
+        # config.obs=False swaps in no-op metrics (the A/B knob) and
+        # silences the process-global codec frame stats; the flight
+        # recorder stays on (see obs/trace.py).
+        from ripplemq_tpu.obs.metrics import Metrics
+        from ripplemq_tpu.obs.trace import FlightRecorder
+        from ripplemq_tpu.wire import codec as _codec
+
+        self.metrics = Metrics(enabled=config.obs)
+        self.recorder = FlightRecorder()
+        # Codec stats are process-global: set them symmetrically (last
+        # constructed broker wins) rather than latching off forever —
+        # a one-way disable would freeze the A/B's obs=True arm when an
+        # obs=False broker ran earlier in the same process.
+        _codec.enable_stats(config.obs)
         self._net = net
         self._engine_mode = engine_mode
         # Multi-host spmd: engine-worker endpoints on the OTHER hosts of
@@ -242,6 +259,7 @@ class BrokerServer:
                 self._store_dir, erasure=True,
                 segment_bytes=config.segment_bytes,
                 retention_bytes=config.store_retention_bytes,
+                metrics=self.metrics,
             )
         else:
             from ripplemq_tpu.storage.memstore import MemoryRoundStore
@@ -362,6 +380,9 @@ class BrokerServer:
             "engine mode %s)",
             self.broker_id, self.manager.current_epoch(), self._engine_mode,
         )
+        self.recorder.record("controller_boot",
+                             epoch=self.manager.current_epoch(),
+                             engine_mode=self._engine_mode)
         dp = None
         try:
             # The WHOLE boot sequence is one failure domain: a raise from
@@ -407,6 +428,9 @@ class BrokerServer:
                 pipeline_depth=self.config.pipeline_depth,
                 read_coalesce_s=self.config.read_coalesce_s,
                 durability=self.config.durability,
+                obs=self.config.obs,
+                metrics=self.metrics,
+                recorder=self.recorder,
             )
             if image is not None:
                 dp.install(image, settled_gaps=gaps)
@@ -449,6 +473,10 @@ class BrokerServer:
             # is merely still starting), abdicate the same way a
             # mid-call lockstep break does.
             self._boot_failures += 1
+            self.recorder.record(
+                "boot_failed", consecutive=self._boot_failures,
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
             log.warning(
                 "broker %d: data-plane boot failed (%d consecutive): "
                 "%s: %s", self.broker_id, self._boot_failures,
@@ -498,6 +526,7 @@ class BrokerServer:
             ),
             rpc_timeout_s=min(2.0, self.config.rpc_timeout_s),
             ack_timeout_s=self.config.rpc_timeout_s,
+            metrics=self.metrics,
         )
         return self._replicator
 
@@ -590,6 +619,14 @@ class BrokerServer:
                 return self._handle_repl_rounds(req)
             if t == "admin.stats":
                 return self._handle_stats(req)
+            if t == "admin.metrics":
+                return self._handle_metrics(req)
+            if t == "admin.trace":
+                return self._handle_trace(req)
+            if t == "admin.postmortem":
+                from ripplemq_tpu.obs.postmortem import collect_postmortem
+
+                return collect_postmortem(self)
             if t.startswith("shard."):
                 return self._handle_shard(t, req)
             if t.startswith("engine."):
@@ -608,6 +645,40 @@ class BrokerServer:
             return {"ok": False, "error": f"bad_request: {type(e).__name__}: {e}"}
 
     # -- observability -----------------------------------------------------
+
+    def _handle_metrics(self, req: dict) -> dict:
+        """The metrics-registry snapshot (counters/gauges/log-bucketed
+        histogram summaries — obs/metrics.py) plus the process-global
+        wire-codec frame stats. Cheap enough to poll; the heavyweight
+        one-shot diagnosis surface is admin.postmortem."""
+        del req
+        from ripplemq_tpu.wire import codec as _codec
+
+        out = {
+            "ok": True,
+            "obs": self.config.obs,
+            "metrics": self.metrics.snapshot(),
+            # Codec stats are PROCESS-global (the codec is stateless
+            # module functions): in an in-proc multi-broker cluster they
+            # aggregate across every broker sharing the process.
+            "wire": _codec.codec_stats(),
+        }
+        dp = self._local_engine()
+        if dp is not None and dp.metrics is not self.metrics:
+            # An externally-injected plane keeps its own registry.
+            out["engine_metrics"] = dp.metrics.snapshot()
+        return out
+
+    def _handle_trace(self, req: dict) -> dict:
+        """The flight-recorder window (obs/trace.py), oldest first;
+        `last` clips to the most recent N events."""
+        last = req.get("last")
+        last = int(last) if last is not None else None
+        out = {"ok": True, "trace": self.recorder.snapshot(last=last)}
+        dp = self._local_engine()
+        if dp is not None and dp.recorder is not self.recorder:
+            out["engine_trace"] = dp.recorder.snapshot(last=last)
+        return out
 
     def _handle_stats(self, req: dict) -> dict:
         """Broker stats/health snapshot: metadata role, controller state,
@@ -801,6 +872,8 @@ class BrokerServer:
         except CorruptStoreError as e:
             target = quarantine_store(self._store_dir)
             self._store_quarantined = True
+            self.recorder.record("store_quarantine", when="boot",
+                                 error=str(e)[:200])
             log.warning(
                 "broker %d: store failed its boot health walk (%s); "
                 "quarantined to %s — reopening empty, will re-replicate "
@@ -829,10 +902,13 @@ class BrokerServer:
         target = quarantine_store(self._store_dir)
         self._store_quarantined = True
         self._quarantine_left_set = False
+        self.recorder.record("store_quarantine", when="midlife",
+                             error=f"{type(cause).__name__}: {cause}"[:200])
         self._round_store = SegmentStore(
             self._store_dir, erasure=True,
             segment_bytes=self.config.segment_bytes,
             retention_bytes=self.config.store_retention_bytes,
+            metrics=self.metrics,
         )
         log.warning(
             "broker %d: store failed its replay scan mid-life (%s: %s); "
@@ -1593,6 +1669,9 @@ class BrokerServer:
             "to broker %d (epoch %d)",
             self.broker_id, reason, cmd["controller"], cmd["epoch"],
         )
+        self.recorder.record("abdicate", reason=str(reason)[:200],
+                             successor=cmd["controller"],
+                             epoch=cmd["epoch"])
         self.propose_cmd(cmd)
         # The apply flips current_controller; the fence duty (same duty
         # pass) releases the broken plane.
@@ -1610,6 +1689,10 @@ class BrokerServer:
             "releasing the device program",
             self.broker_id, self.manager.current_epoch(),
             self.manager.current_controller(),
+        )
+        self.recorder.record(
+            "deposed", epoch=self.manager.current_epoch(),
+            successor=self.manager.current_controller(),
         )
         dp = self.dataplane
         self.dataplane = None
@@ -1842,6 +1925,8 @@ class BrokerServer:
                 if joined:
                     break
             if joined:
+                self.recorder.record("standby_joined", standby=cand,
+                                     epoch=epoch)
                 log.info("broker %d: standby %d caught up and joined the "
                          "standby set", self.broker_id, cand)
             else:

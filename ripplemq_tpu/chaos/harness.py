@@ -187,6 +187,43 @@ def _drain_partition(cluster: InProcCluster, topic: str, pid: int,
     return out
 
 
+def _collect_broker_obs(cluster) -> tuple[dict[str, dict], list[dict]]:
+    """Pull one admin.postmortem bundle per reachable broker (both
+    backends reach it over their real transport — the RPC surface is
+    the point: what an operator would collect, not an in-proc reach-in)
+    and flatten the bundles' flight-recorder windows into timeline
+    events tagged with their source broker. Unreachable/killed brokers
+    are skipped, not fatal — a postmortem that fails because half the
+    cluster is down must still report the surviving half."""
+    postmortems: dict[str, dict] = {}
+    events: list[dict] = []
+    client = cluster.client("obs-collect")
+    for bid in cluster.brokers:
+        addr = cluster.broker_addr(bid)
+        try:
+            pm = client.call(addr, {"type": "admin.postmortem"},
+                             timeout=15.0)
+            if pm.get("ok"):
+                postmortems[str(bid)] = pm
+        except Exception:
+            pass  # trace below is independent — keep collecting
+        # The timeline wants the FULL ring, not the postmortem's recent
+        # clip: under traffic the per-round events scroll control-plane
+        # transitions (boots, elections, deposals) out of a short window
+        # in seconds, and those are exactly what a fault timeline is
+        # for. Fetched regardless of the postmortem's fate: a broker
+        # whose device-fetching postmortem wedged is the one whose
+        # lifecycle events the timeline most needs.
+        try:
+            tr = client.call(addr, {"type": "admin.trace"}, timeout=15.0)
+        except Exception:
+            continue
+        if tr.get("ok"):
+            for ev in tr.get("trace", []) + tr.get("engine_trace", []):
+                events.append({"src": f"broker{bid}", **ev})
+    return postmortems, events
+
+
 def run_chaos(
     seed: int,
     n_brokers: int = 3,
@@ -200,6 +237,8 @@ def run_chaos(
     converge_timeout_s: float = 30.0,
     include_history: bool = False,
     backend: str = "inproc",
+    include_postmortems: bool = False,
+    include_timeline: bool = False,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -208,7 +247,15 @@ def run_chaos(
     `backend` picks the cluster substrate: "inproc" (single process,
     fake transport — network faults, fastest) or "proc" (real broker
     subprocesses over TCP — SIGKILL + disk-fault schedules against the
-    deployment shape; chaos.proc_cluster). Verdict schema is identical."""
+    deployment shape; chaos.proc_cluster). Verdict schema is identical.
+
+    A VIOLATING verdict always carries `postmortems` (one
+    admin.postmortem bundle per reachable broker — the diagnosis the
+    PR 4 wedge needed a debugger session for) and `timeline` (the
+    nemesis's wall-clocked fault ops merged with every broker's flight-
+    recorder events, sorted by time: fault vs lifecycle in one view).
+    `include_postmortems`/`include_timeline` force them onto clean
+    verdicts too (profiles/chaos_soak.py --postmortems/--timeline)."""
     t0 = time.time()
     topic = "chaos"
     tmp = None
@@ -300,6 +347,21 @@ def run_chaos(
         violations = check_history(history.ops(), final_logs,
                                    allow_wire_dups=dup_faults)
         ops = history.ops()
+        # Telemetry collection — while the cluster is still up. Every
+        # VIOLATING verdict carries the full diagnosis (per-broker
+        # postmortem bundles + the merged fault-vs-lifecycle timeline);
+        # clean runs collect only on request.
+        postmortems: dict[str, dict] = {}
+        broker_events: list[dict] = []
+        if violations or include_postmortems or include_timeline:
+            postmortems, broker_events = _collect_broker_obs(cluster)
+        if violations or include_timeline:
+            verdict["timeline"] = sorted(
+                list(nemesis.timeline) + broker_events,
+                key=lambda e: e.get("t", 0.0),
+            )
+        if violations or include_postmortems:
+            verdict["postmortems"] = postmortems
         verdict.update(
             trace=nemesis.trace,
             # Injection forensics (what the disk ops actually hit) —

@@ -182,7 +182,21 @@ class Nemesis:
         # that caused them (forensics; NOT part of the byte-reproducible
         # trace — what the damage hit depends on what the run persisted).
         self.disk_fault_log: list[dict] = []
+        # WALL-CLOCKED fault timeline: every applied op (and heal/
+        # restart) stamped with time.time() at application, in the same
+        # {t, src, type, ...} shape as the brokers' flight-recorder
+        # events — run_chaos merges the two into ONE fault-vs-lifecycle
+        # timeline. Informational (timing varies run to run); the
+        # byte-reproducible artifact remains `trace`.
+        self.timeline: list[dict] = []
         self._crashed: set[int] = set()
+
+    def _mark(self, phase: int, op: dict) -> None:
+        self.timeline.append({
+            "t": time.time(), "src": "nemesis", "phase": phase,
+            "type": op["op"],
+            **{k: v for k, v in op.items() if k != "op"},
+        })
 
     # ------------------------------------------------------------- applying
 
@@ -193,6 +207,7 @@ class Nemesis:
         for op in self.schedule[phase]:
             self._apply(dict(op))
             self.trace.append({"phase": phase, **op})
+            self._mark(phase, op)
 
     def _apply(self, op: dict) -> None:
         kind = op["op"]
@@ -255,11 +270,13 @@ class Nemesis:
         for b in sorted(self._crashed):
             self.cluster.restart(b)
             self.trace.append({"phase": phase, "op": "restart", "broker": b})
+            self._mark(phase, {"op": "restart", "broker": b})
         self._crashed.clear()
         if net is not None:
             for w in self.lockstep_workers:
                 net.set_up(w)
         self.trace.append({"phase": phase, "op": "heal"})
+        self._mark(phase, {"op": "heal"})
 
     # ---------------------------------------------------------- convergence
 

@@ -122,6 +122,7 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "segment_bytes": config.segment_bytes,
         "durability": config.durability,
         "linearizable_reads": config.linearizable_reads,
+        "obs": config.obs,
     }
 
 
@@ -178,7 +179,9 @@ class ProcCluster:
         h.proc = subprocess.Popen(
             [sys.executable, "-m", "ripplemq_tpu.broker",
              "--id", str(broker_id), "--config", self.config_path,
-             "--data-dir", self.data_dir],
+             # JSON-lines logs: each soak's broker-N.log is machine-
+             # greppable (jq) next to the verdict's merged timeline.
+             "--data-dir", self.data_dir, "--log-json"],
             env=self.env, cwd=_REPO, stdout=logf, stderr=subprocess.STDOUT,
         )
         logf.close()  # the child holds its own fd

@@ -102,6 +102,14 @@ class ClusterConfig:
     # across a correlated full-cluster crash, at the cost of one fsync
     # latency on every round's ack path.
     durability: str = "async"
+    # Telemetry plane (ripplemq_tpu.obs): ON by default — the metrics
+    # registry instruments every host-path stage and admin.metrics /
+    # admin.postmortem serve it. False swaps in no-op metrics and
+    # disables the codec's frame stats — the A/B knob (measured ≤3% e2e
+    # delta, PROFILE.md "telemetry overhead"). The flight recorder
+    # (admin.trace) stays on either way: its per-round cost is a few
+    # hundred ns and its value is being on when nobody planned to need it.
+    obs: bool = True
     # RPC worker pool per broker. A produce/engine.append handler BLOCKS
     # its worker until the round commits, so this caps a broker's
     # in-flight appends — size it to the offered concurrency (threads
@@ -230,6 +238,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["rpc_workers"] = int(raw["rpc_workers"])
     if "linearizable_reads" in raw:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
+    if "obs" in raw:
+        extra["obs"] = bool(raw["obs"])
     if "durability" in raw:
         extra["durability"] = str(raw["durability"])
     if "coalesce_s" in raw:

@@ -1,0 +1,23 @@
+"""Telemetry plane: metrics registry, flight recorder, postmortem bundles.
+
+Three surfaces, one package (the observability layer MegaScale argues
+must be built into the system rather than bolted on per-incident,
+arXiv:2402.15627):
+
+- `obs.metrics` — a lock-cheap registry of counters, gauges, and
+  log-bucketed histograms instrumenting every host-path stage (produce,
+  dispatch, the settle pipeline, replication group-commit, store
+  append/fsync, wire codec). On by default; `ClusterConfig.obs = False`
+  swaps in no-op metrics for A/B.
+- `obs.trace` — a fixed-size ring flight recorder of per-round
+  lifecycle events and control-plane transitions, always on.
+- `obs.postmortem` — the one-shot diagnosis bundle (control-table vs
+  device terms, log ends, stall streaks, settled gaps, the recent trace
+  ring) served as `admin.postmortem` by every broker.
+"""
+
+from ripplemq_tpu.obs.metrics import Metrics
+from ripplemq_tpu.obs.postmortem import collect_postmortem
+from ripplemq_tpu.obs.trace import FlightRecorder
+
+__all__ = ["Metrics", "FlightRecorder", "collect_postmortem"]

@@ -1,0 +1,70 @@
+"""Flight recorder: a fixed-size ring of structured lifecycle events.
+
+Always on (unlike the metrics registry there is no off switch): the
+whole point of a flight recorder is that the events preceding a failure
+were already captured when the failure is noticed — the PR 4 term-skew
+wedge was diagnosed by re-running under probes precisely because nothing
+had recorded the election/advert interleaving the first time. Elle's
+lesson applies (arXiv:2003.10554): a checker verdict is most useful when
+it points at the responsible window of the history, and the ring IS that
+window.
+
+Cost per append: one itertools.count tick (C-level, thread-safe slot
+assignment), one clock read, one tuple + kwargs dict build, one list
+store — ~a few hundred ns. Events are recorded per ROUND or per
+control-plane transition, never per message, so even a saturated broker
+appends a few thousand events/s against a default 4096-slot ring
+(~the last second or two of life under full load; minutes when idle or
+faulted — exactly when the history matters).
+
+Ring writes are wait-free against each other (distinct slots via the
+atomic counter); `snapshot()` reads racy-consistent — an entry being
+overwritten mid-read can surface as a slightly out-of-window event,
+never as a torn tuple (slot stores are single reference assignments).
+
+Event timestamps are WALL CLOCK (`time.time()`), deliberately unlike
+the metrics clock: traces from different processes (proc-backend
+brokers, the nemesis fault log) merge into one timeline by `t`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Optional
+
+_DEFAULT_CAPACITY = 4096
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self._cap = max(16, int(capacity))
+        self._buf: list = [None] * self._cap
+        self._seq = itertools.count()
+        self.clock: Callable[[], float] = (
+            clock if clock is not None else time.time
+        )
+
+    def record(self, etype: str, **fields) -> None:
+        """Append one event. `fields` must stay wire-primitive (str keys,
+        int/float/str/bool/list values) — snapshots travel over
+        `admin.trace` through the codec verbatim."""
+        seq = next(self._seq)  # atomic slot assignment (C-level next)
+        self._buf[seq % self._cap] = (seq, self.clock(), etype, fields)
+
+    def snapshot(self, last: Optional[int] = None) -> list[dict]:
+        """The ring's live window in seq order (oldest first), optionally
+        clipped to the most recent `last` events. Wire-encodable."""
+        entries = [e for e in self._buf if e is not None]
+        entries.sort(key=lambda e: e[0])
+        if last is not None and last >= 0:
+            # last=0 must mean ZERO events ([-0:] would be the whole ring).
+            entries = entries[-last:] if last > 0 else []
+        # Reserved keys always win over same-named fields: `seq` is the
+        # ring's ordering contract (snapshot is seq-sorted), and a field
+        # shadowing it would silently break every timeline consumer.
+        return [
+            {**fields, "seq": seq, "t": t, "type": etype}
+            for seq, t, etype, fields in entries
+        ]

@@ -189,8 +189,25 @@ class SegmentStore:
     def __init__(self, directory: str, segment_bytes: int = 64 << 20,
                  use_native: Optional[bool] = None,
                  erasure: bool = False,
-                 retention_bytes: Optional[int] = None) -> None:
+                 retention_bytes: Optional[int] = None,
+                 metrics=None) -> None:
         self.directory = directory
+        # Telemetry (obs.Metrics registry, usually the owning broker's):
+        # append latency/bytes and fsync latency are the disk half of the
+        # settle-path decomposition. None or a DISABLED registry → the
+        # handles stay None and the hot paths skip even the clock reads
+        # (the obs=False A/B arm must actually shed the cost).
+        self.metrics = metrics
+        if metrics is not None and getattr(metrics, "enabled", True):
+            self._h_append = metrics.histogram("store.append_us")
+            self._h_fsync = metrics.histogram("store.fsync_us")
+            self._c_append_bytes = metrics.counter("store.append_bytes")
+            self._c_records = metrics.counter("store.append_records")
+            self._clock = metrics.clock
+        else:
+            self._h_append = self._h_fsync = None
+            self._c_append_bytes = self._c_records = None
+            self._clock = None
         self.segment_bytes = segment_bytes
         self.erasure = erasure
         # Size-capped disk retention: gc() deletes the OLDEST sealed
@@ -256,6 +273,17 @@ class SegmentStore:
                 f"record payload of {len(payload)} bytes exceeds the "
                 f"1 GiB store record cap"
             )
+        t0 = self._clock() if self._h_append is not None else 0.0
+        try:
+            return self._append_locked(rec_type, slot, base, payload)
+        finally:
+            if self._h_append is not None:
+                self._h_append.observe(self._clock() - t0)
+                self._c_append_bytes.inc(len(payload))
+                self._c_records.inc()
+
+    def _append_locked(self, rec_type: int, slot: int, base: int,
+                       payload: bytes) -> tuple[int, int]:
         with self._lock:
             if self._handle is not None:
                 seg = ctypes.c_int()
@@ -302,6 +330,7 @@ class SegmentStore:
         frames: list[bytes] = []
         rel: list[int] = []  # payload offset of each record in the blob
         pos = 0
+        payload_total = 0  # append_bytes counts PAYLOAD bytes (both paths)
         for rec_type, slot, base, payload in records:
             if len(payload) > (1 << 30):
                 raise ValueError(
@@ -315,7 +344,19 @@ class SegmentStore:
             frames.append(payload)
             rel.append(pos + _HEADER.size)
             pos += _HEADER.size + len(payload)
+            payload_total += len(payload)
         blob = b"".join(frames)
+        t0 = self._clock() if self._h_append is not None else 0.0
+        try:
+            return self._append_blob_locked(blob, rel)
+        finally:
+            if self._h_append is not None:
+                self._h_append.observe(self._clock() - t0)
+                self._c_append_bytes.inc(payload_total)
+                self._c_records.inc(len(records))
+
+    def _append_blob_locked(self, blob: bytes,
+                            rel: list[int]) -> list[tuple[int, int]]:
         with self._lock:
             if self._handle is not None:
                 seg = ctypes.c_int()
@@ -343,6 +384,7 @@ class SegmentStore:
 
     def flush(self) -> None:
         """fsync the active segment (the durability barrier)."""
+        t0 = self._clock() if self._h_fsync is not None else 0.0
         with self._lock:
             if self._handle is not None:
                 if self._lib.segstore_flush(self._handle) != 0:
@@ -352,6 +394,8 @@ class SegmentStore:
                 os.fsync(self._file.fileno())
             else:
                 return  # closed: close()'s final fsync was the barrier
+        if self._h_fsync is not None:
+            self._h_fsync.observe(self._clock() - t0)
         if self.erasure:
             self._kick_erasure()
 
@@ -409,6 +453,7 @@ class SegmentStore:
         seg = self._active_seg
         if seg < 0:
             return  # nothing appended yet
+        t0 = self._clock() if self._h_fsync is not None else 0.0
         first = self._last_synced_seg if self._last_synced_seg >= 0 else seg
         for idx in range(first, seg + 1):
             try:
@@ -420,6 +465,8 @@ class SegmentStore:
             finally:
                 os.close(fd)
         self._last_synced_seg = seg
+        if self._h_fsync is not None:
+            self._h_fsync.observe(self._clock() - t0)
 
     def _kick_erasure(self) -> None:
         """Start (or skip, if one is running) the background shard

@@ -13,6 +13,7 @@ free; unconfigured loggers follow stdlib defaults (warnings+ to stderr).
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 from typing import Optional, TextIO
@@ -29,17 +30,53 @@ def get_logger(subsystem: str) -> logging.Logger:
     return logging.getLogger(f"{_ROOT}.{subsystem}")
 
 
+class _JsonLinesFormatter(logging.Formatter):
+    """One JSON object per log record: machine-greppable broker logs
+    that merge cleanly with the telemetry plane's event timeline (the
+    proc chaos backend launches its subprocess brokers with this, so a
+    soak's broker-N.log sits `jq`-able next to the trace ring). Fields:
+    ts (epoch seconds), level, subsystem (the logger name under the
+    ripplemq root), broker (the launching process's id, if known),
+    thread, msg; exceptions land in `exc`."""
+
+    def __init__(self, broker_id: Optional[int] = None) -> None:
+        super().__init__()
+        self._broker_id = broker_id
+
+    def format(self, record: logging.LogRecord) -> str:
+        name = record.name
+        doc = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "subsystem": name[len(_ROOT) + 1:] if
+            name.startswith(_ROOT + ".") else name,
+            "broker": self._broker_id,
+            "thread": record.threadName,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, ensure_ascii=False)
+
+
 def configure_logging(level: str | int = "INFO",
-                      stream: Optional[TextIO] = None) -> logging.Logger:
+                      stream: Optional[TextIO] = None,
+                      json_lines: bool = False,
+                      broker_id: Optional[int] = None) -> logging.Logger:
     """Attach one console handler to the ripplemq root logger (idempotent:
     reconfiguring replaces the previous handler, so tests and re-entrant
-    mains don't stack duplicates). Returns the root logger."""
+    mains don't stack duplicates). `json_lines=True` swaps the log4j2-
+    style pattern for one JSON object per record (`_JsonLinesFormatter`),
+    with `broker_id` stamped into every line. Returns the root logger."""
     root = logging.getLogger(_ROOT)
     if isinstance(level, str):
         level = getattr(logging, level.upper(), logging.INFO)
     root.setLevel(level)
     handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
-    handler.setFormatter(logging.Formatter(_PATTERN, datefmt=_DATEFMT))
+    if json_lines:
+        handler.setFormatter(_JsonLinesFormatter(broker_id=broker_id))
+    else:
+        handler.setFormatter(logging.Formatter(_PATTERN, datefmt=_DATEFMT))
     for h in list(root.handlers):
         root.removeHandler(h)
     root.addHandler(handler)

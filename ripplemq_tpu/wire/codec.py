@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import time
 
 _NONE = b"n"
 _TRUE = b"t"
@@ -151,13 +152,69 @@ def _encode_vector(out: bytearray, items) -> None:
         out += x
 
 
+# --- codec telemetry --------------------------------------------------------
+# PROCESS-GLOBAL frame counters (the codec is stateless module functions
+# shared by every transport in the process, so these aggregate across
+# brokers of an in-proc cluster — admin.metrics labels them as such).
+# Plain-int adds, unlocked: same accepted-race contract as obs.metrics
+# counters. `enable_stats(False)` removes even the two clock reads per
+# frame (the ClusterConfig.obs A/B knob reaches here through the broker).
+
+
+class _CodecStats:
+    __slots__ = ("encode_frames", "encode_bytes", "encode_ns",
+                 "decode_frames", "decode_bytes", "decode_ns")
+
+    def __init__(self) -> None:
+        self.encode_frames = 0
+        self.encode_bytes = 0
+        self.encode_ns = 0
+        self.decode_frames = 0
+        self.decode_bytes = 0
+        self.decode_ns = 0
+
+
+_STATS = _CodecStats()
+_STATS_ENABLED = True
+
+
+def enable_stats(on: bool) -> None:
+    global _STATS_ENABLED
+    _STATS_ENABLED = bool(on)
+
+
+def codec_stats() -> dict:
+    """Wire-encodable snapshot (avg_us derived so rates survive the
+    racy-read contract gracefully)."""
+    s = _STATS
+    return {
+        "enabled": _STATS_ENABLED,
+        "encode_frames": s.encode_frames,
+        "encode_bytes": s.encode_bytes,
+        "encode_avg_us": round(s.encode_ns / s.encode_frames / 1e3, 2)
+        if s.encode_frames else 0,
+        "decode_frames": s.decode_frames,
+        "decode_bytes": s.decode_bytes,
+        "decode_avg_us": round(s.decode_ns / s.decode_frames / 1e3, 2)
+        if s.decode_frames else 0,
+    }
+
+
 def encode(v, bulk: bool = True) -> bytes:
     """Encode one value. `bulk=False` disables the packed-vector fast
     path (generic per-element encoding for bytes lists) — the legacy
     wire form, kept for A/B and interop tests; both decode identically."""
+    stats = _STATS_ENABLED
+    t0 = time.perf_counter_ns() if stats else 0
     out = bytearray()
     _encode_into(out, v, bulk)
-    return bytes(out)
+    raw = bytes(out)
+    if stats:
+        s = _STATS
+        s.encode_ns += time.perf_counter_ns() - t0
+        s.encode_frames += 1
+        s.encode_bytes += len(raw)
+    return raw
 
 
 def _read_length(buf: memoryview, pos: int) -> tuple[int, int]:
@@ -227,9 +284,16 @@ def _decode_at(buf: memoryview, pos: int):
 
 
 def decode(raw: bytes | memoryview):
+    stats = _STATS_ENABLED
+    t0 = time.perf_counter_ns() if stats else 0
     v, pos = _decode_at(memoryview(raw), 0)
     if pos != len(raw):
         raise ValueError(f"trailing bytes after value ({pos} != {len(raw)})")
+    if stats:
+        s = _STATS
+        s.decode_ns += time.perf_counter_ns() - t0
+        s.decode_frames += 1
+        s.decode_bytes += len(raw)
     return v
 
 

@@ -3,9 +3,24 @@
 
 from __future__ import annotations
 
+import time
+
 from ripplemq_tpu.core.config import EngineConfig
 from ripplemq_tpu.core.encode import build_step_input, decode_entries
 from ripplemq_tpu.core.state import StepInput
+
+
+def wait_until(pred, timeout=30.0, interval=0.05):
+    """Poll `pred` until true or `timeout` elapses — THE copy (it had
+    drifted into half a dozen test modules with divergent defaults;
+    call sites that relied on a module-local longer default now pass it
+    explicitly)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
 
 
 def small_cfg(**kw) -> EngineConfig:

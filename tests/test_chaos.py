@@ -37,11 +37,29 @@ def test_fixed_seed_chaos_smoke(seed):
     # the default 30 s can flake while safety stays clean — give the
     # probe headroom; the safety checker's verdict is what gates.
     verdict = run_chaos(seed=seed, phases=PHASES, phase_s=0.5,
-                        converge_timeout_s=90.0)
+                        converge_timeout_s=90.0,
+                        include_postmortems=True, include_timeline=True)
     assert verdict["violations"] == [], (
         f"seed {seed} safety violations: {verdict['violations']}\n"
         f"trace: {trace_json(verdict['trace'])}"
     )
+    # Telemetry-plane acceptance (ISSUE 5): the verdict carries one
+    # postmortem bundle per reachable broker — the exact surface a
+    # violating run attaches automatically — and the merged
+    # fault-vs-lifecycle timeline interleaves nemesis fault ops with
+    # broker flight-recorder events in wall-clock order.
+    assert verdict["postmortems"], "no postmortem bundles collected"
+    for bid, pm in verdict["postmortems"].items():
+        assert pm["ok"] and pm["broker"] == int(bid)
+        assert "metrics" in pm and "trace" in pm and "controller" in pm
+    assert any(pm["engine"] is not None
+               for pm in verdict["postmortems"].values()), (
+        "no reachable broker reported an engine section"
+    )
+    tl = verdict["timeline"]
+    srcs = {e["src"] for e in tl}
+    assert "nemesis" in srcs and any(s.startswith("broker") for s in srcs)
+    assert [e["t"] for e in tl] == sorted(e["t"] for e in tl)
     assert verdict["converged"], (
         f"seed {seed} never re-converged after heal: "
         f"{verdict['convergence']}"

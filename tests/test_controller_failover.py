@@ -14,16 +14,7 @@ import pytest
 
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
-from tests.helpers import small_cfg
-
-
-def wait_until(pred, timeout=60.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
+from tests.helpers import small_cfg, wait_until
 
 
 @pytest.fixture()
@@ -49,7 +40,8 @@ def _any_survivor(c, dead):
 
 def _wait_standbys(c, n, dead=()):
     assert wait_until(
-        lambda: len(_any_survivor(c, dead).manager.current_standbys()) >= n
+        lambda: len(_any_survivor(c, dead).manager.current_standbys()) >= n,
+        timeout=60,
     ), "standby set never reached target"
 
 
@@ -178,13 +170,15 @@ def test_controller_death_promotes_standby_zero_loss(cluster4):
 
     # A standby is promoted under a bumped epoch...
     assert wait_until(
-        lambda: _any_survivor(c, dead).manager.current_controller() != ctrl
+        lambda: _any_survivor(c, dead).manager.current_controller() != ctrl,
+        timeout=60,
     ), "controller never moved"
     new_ctrl = _any_survivor(c, dead).manager.current_controller()
     assert new_ctrl != ctrl
     assert _any_survivor(c, dead).manager.current_epoch() >= 1
     # ...boots the device program from its stream copy...
-    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None), (
+    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None,
+                      timeout=60), (
         "promoted standby never booted a dataplane"
     )
     # ...and traffic keeps flowing (produce success after the handover).
@@ -217,10 +211,12 @@ def test_deposed_controller_fences(cluster4):
     # Partition the controller away (still running).
     c.net.set_down(c.brokers[ctrl].addr)
     assert wait_until(
-        lambda: _any_survivor(c, {ctrl}).manager.current_controller() != ctrl
+        lambda: _any_survivor(c, {ctrl}).manager.current_controller() != ctrl,
+        timeout=60,
     ), "controller never moved"
     new_ctrl = _any_survivor(c, {ctrl}).manager.current_controller()
-    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None)
+    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None,
+                      timeout=60)
     _produce(c, client, "t", 0, b"post-promotion", dead={ctrl})
 
     # Heal the partition: the old controller learns the newer epoch and

@@ -18,6 +18,7 @@ import pytest
 
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
+from tests.helpers import wait_until
 
 
 @pytest.fixture()
@@ -31,15 +32,6 @@ def cluster5():
     with InProcCluster(config) as c:
         c.wait_for_leaders()
         yield c
-
-
-def wait_until(pred, timeout=30.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
 
 
 def test_broker_death_heals_assignment_and_leadership(cluster5):

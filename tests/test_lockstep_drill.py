@@ -244,9 +244,8 @@ def test_boot_time_lockstep_failure_abdicates():
 
     from ripplemq_tpu.metadata.models import Topic
     from tests.broker_harness import InProcCluster, make_config
-    from tests.helpers import small_cfg
-    from tests.test_controller_failover import _produce, _wait_standbys, \
-        wait_until
+    from tests.helpers import small_cfg, wait_until
+    from tests.test_controller_failover import _produce, _wait_standbys
 
     s = socketmod.socket()
     s.bind(("127.0.0.1", 0))

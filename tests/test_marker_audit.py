@@ -125,6 +125,6 @@ def test_known_soaks_stay_slow_marked():
     keep their marks (deleting a mark reintroduces the timeout)."""
     for name in ("test_multihost", "test_soak_random", "test_soak_gc",
                  "test_lockstep_drill", "test_chaos_soak",
-                 "test_proc_chaos_soak"):
+                 "test_proc_chaos_soak", "test_obs_soak"):
         path = TESTS_DIR / f"{name}.py"
         assert _is_slow_marked(path), f"{name} lost its slow mark"

@@ -19,15 +19,7 @@ from ripplemq_tpu.broker.manager import PartitionManager
 from ripplemq_tpu.broker.server import BrokerServer
 from ripplemq_tpu.wire.transport import InProcNetwork
 from tests.broker_harness import InProcCluster, make_config
-
-
-def wait_until(pred, timeout=30.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
+from tests.helpers import wait_until
 
 
 # ---------------------------------------------------------------- admin.stats
@@ -218,3 +210,392 @@ def test_membership_poll_gates_liveness_reaction():
         time.sleep(1.5)  # >> liveness horizon (0.6 s), << poll period
         survivor = next(b for i, b in c.brokers.items() if i != victim)
         assert victim in survivor.manager.live  # not re-planned yet
+
+
+# ===================================================== telemetry plane (obs/)
+
+# The admin.stats SCHEMA LOCK: every field profiles/bench/operators
+# consume, pinned as an exact key set so a refactor cannot silently drop
+# one (README "Observability" documents each). Adding a field means
+# extending these sets AND the README table — that review step is the
+# point.
+STATS_TOP_KEYS = {
+    "ok", "broker", "address", "boot_failures", "store_quarantined",
+    "metadata", "controller", "topics", "live", "duty_errors",
+    "erasure_errors", "engine",
+}
+STATS_ENGINE_KEYS = {
+    "mode", "rounds", "dispatches", "read_queries", "read_dispatches",
+    "read_cache_hits", "mirror_gap_slots", "settled_gap_slots",
+    "stalled_slots", "committed_entries", "step_errors", "settle",
+    "partitions", "degraded_slots", "degraded",
+}
+STATS_SETTLE_KEYS = {"window", "occupancy_mean", "samples",
+                     "backpressure_waits"}
+
+
+def test_admin_stats_schema_lock():
+    with InProcCluster(make_config(3)) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl = next(b for b in c.brokers.values() if b.is_controller)
+        front = next(b for b in c.brokers.values() if not b.is_controller)
+        stats = client.call(ctrl.addr, {"type": "admin.stats"}, timeout=5.0)
+        assert set(stats) == STATS_TOP_KEYS, (
+            f"admin.stats top-level schema drifted: "
+            f"{set(stats) ^ STATS_TOP_KEYS}"
+        )
+        assert set(stats["engine"]) == STATS_ENGINE_KEYS, (
+            f"admin.stats engine schema drifted: "
+            f"{set(stats['engine']) ^ STATS_ENGINE_KEYS}"
+        )
+        assert set(stats["engine"]["settle"]) == STATS_SETTLE_KEYS
+        assert set(stats["metadata"]) == {"role", "term", "leader_hint"}
+        assert set(stats["controller"]) == {"id", "epoch", "standbys",
+                                            "is_self"}
+        # `slots` is additive (request-gated), not schema drift.
+        detail = client.call(ctrl.addr,
+                             {"type": "admin.stats", "slots": [0]},
+                             timeout=5.0)
+        assert set(detail["engine"]) == STATS_ENGINE_KEYS | {"slots"}
+        assert set(detail["engine"]["slots"]["0"]) == {"commit", "log_end",
+                                                       "trim"}
+        fstats = client.call(front.addr, {"type": "admin.stats"},
+                             timeout=5.0)
+        assert set(fstats) == STATS_TOP_KEYS and fstats["engine"] is None
+
+
+def test_admin_metrics_and_trace_surface():
+    """admin.metrics and admin.trace answer on every broker; traffic
+    moves the produce/settle counters and appends round-lifecycle
+    events; the trace window is seq-ordered and `last`-clippable."""
+    with InProcCluster(make_config(3)) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl = next(b for b in c.brokers.values() if b.is_controller)
+        resp = client.call(
+            ctrl.addr,
+            {"type": "produce", "topic": "topic1", "partition": 0,
+             "messages": [b"m1", b"m2", b"m3"]},
+            timeout=10.0,
+        )
+        if not resp.get("ok"):
+            resp = client.call(
+                resp["leader_addr"],
+                {"type": "produce", "topic": "topic1", "partition": 0,
+                 "messages": [b"m1", b"m2", b"m3"]},
+                timeout=10.0,
+            )
+        assert resp["ok"], resp
+
+        m = client.call(ctrl.addr, {"type": "admin.metrics"}, timeout=5.0)
+        assert m["ok"] and m["obs"] is True
+        counters = m["metrics"]["counters"]
+        hists = m["metrics"]["histograms"]
+        assert counters["produce.messages"] >= 3
+        assert counters["produce.submits"] >= 1
+        # The settle-stage decomposition is live: every stage histogram
+        # observed at least the produced round.
+        for stage in ("engine.dispatch_us", "settle.commit_wait_us",
+                      "settle.standby_ack_us", "settle.persist_us",
+                      "settle.release_us"):
+            assert hists[stage]["count"] >= 1, stage
+            assert hists[stage]["p99"] >= hists[stage]["p50"]
+        # Replication group-commit telemetry on the sender.
+        assert counters["repl.records"] >= 1
+        assert hists["repl.group_rounds"]["count"] >= 1
+        # Process-global codec frame stats (InProc transports encode for
+        # wire fidelity, so they count here too).
+        assert m["wire"]["enabled"] and m["wire"]["encode_frames"] > 0
+
+        t = client.call(ctrl.addr, {"type": "admin.trace"}, timeout=5.0)
+        assert t["ok"]
+        types = [e["type"] for e in t["trace"]]
+        for needed in ("set_leader", "dispatch", "commit", "settle_enter",
+                       "settle_release"):
+            assert needed in types, (needed, types)
+        seqs = [e["seq"] for e in t["trace"]]
+        assert seqs == sorted(seqs)
+        clipped = client.call(ctrl.addr, {"type": "admin.trace", "last": 3},
+                              timeout=5.0)
+        assert len(clipped["trace"]) == 3
+        assert clipped["trace"][-1]["seq"] == seqs[-1]
+
+        # Frontends serve the surfaces too (broker-level slice).
+        front = next(b for b in c.brokers.values() if not b.is_controller)
+        fm = client.call(front.addr, {"type": "admin.metrics"}, timeout=5.0)
+        assert fm["ok"] and "metrics" in fm
+
+
+def test_obs_knob_disables_metrics_not_trace():
+    """ClusterConfig.obs=False swaps in no-op metrics (admin.metrics
+    reports enabled=False, zero counters) while the flight recorder
+    keeps recording — the documented A/B contract."""
+    from ripplemq_tpu.wire import codec as _codec
+
+    try:
+        with InProcCluster(make_config(3, obs=False)) as c:
+            c.wait_for_leaders()
+            client = c.client()
+            ctrl = next(b for b in c.brokers.values() if b.is_controller)
+            resp = client.call(
+                ctrl.addr,
+                {"type": "produce", "topic": "topic1", "partition": 0,
+                 "messages": [b"x"]},
+                timeout=10.0,
+            )
+            if not resp.get("ok"):
+                resp = client.call(
+                    resp["leader_addr"],
+                    {"type": "produce", "topic": "topic1", "partition": 0,
+                     "messages": [b"x"]},
+                    timeout=10.0,
+                )
+            assert resp["ok"], resp
+            m = client.call(ctrl.addr, {"type": "admin.metrics"},
+                            timeout=5.0)
+            assert m["ok"] and m["obs"] is False
+            assert m["metrics"]["enabled"] is False
+            assert m["metrics"]["counters"] == {}
+            assert m["metrics"]["histograms"] == {}
+            # The flight recorder stays ON: lifecycle events recorded.
+            t = client.call(ctrl.addr, {"type": "admin.trace"}, timeout=5.0)
+            types = {e["type"] for e in t["trace"]}
+            assert "dispatch" in types and "set_leader" in types
+            # And the postmortem still carries the full engine section
+            # (its data is plane state, not registry state).
+            pm = client.call(ctrl.addr, {"type": "admin.postmortem"},
+                             timeout=10.0)
+            assert pm["ok"] and pm["engine"]["counters"]["dispatches"] >= 1
+    finally:
+        # obs=False silences the PROCESS-global codec stats; restore for
+        # the rest of the test session.
+        _codec.enable_stats(True)
+
+
+# ------------------------------------------------------- registry unit tests
+
+
+def test_metrics_registry_units():
+    from ripplemq_tpu.obs.metrics import Metrics
+
+    ticks = [0.0]
+
+    def fake_clock():
+        ticks[0] += 0.001  # 1 ms per read
+        return ticks[0]
+
+    m = Metrics(clock=fake_clock)
+    c = m.counter("c")
+    c.inc()
+    c.inc(4)
+    assert m.counter("c") is c and c.n == 5
+    g = m.gauge("g")
+    g.set(17)
+    h = m.histogram("h")
+    # Log2 bucketing: 100 us lands in [64, 128) -> quantile reads 128.
+    h.observe(100e-6)
+    assert h.count == 1 and h.quantile(0.5) == 128
+    for _ in range(99):
+        h.observe(100e-6)
+    h.observe(3.0)  # one 3 s outlier
+    s = h.summary()
+    assert s["count"] == 101
+    assert s["p50"] == 128 and s["p90"] == 128
+    assert s["max"] == 3_000_000
+    snap = m.snapshot()
+    assert snap["counters"] == {"c": 5}
+    assert snap["gauges"] == {"g": 17}
+    assert snap["histograms"]["h"]["count"] == 101
+    # Disabled registry: same API, no state, shared null objects.
+    off = Metrics(enabled=False)
+    off.counter("x").inc(1000)
+    off.histogram("y").observe(1.0)
+    assert off.snapshot() == {"enabled": False, "counters": {},
+                              "gauges": {}, "histograms": {}}
+
+
+def test_flight_recorder_ring_wraps_and_clips():
+    from ripplemq_tpu.obs.trace import FlightRecorder
+
+    ticks = [0.0]
+
+    def fake_clock():
+        ticks[0] += 1.0
+        return ticks[0]
+
+    r = FlightRecorder(capacity=16, clock=fake_clock)
+    for i in range(40):
+        r.record("e", i=i)
+    snap = r.snapshot()
+    assert len(snap) == 16  # ring capacity, oldest overwritten
+    assert [e["i"] for e in snap] == list(range(24, 40))
+    assert [e["seq"] for e in snap] == sorted(e["seq"] for e in snap)
+    assert [e["t"] for e in snap] == sorted(e["t"] for e in snap)
+    clipped = r.snapshot(last=4)
+    assert [e["i"] for e in clipped] == [36, 37, 38, 39]
+    assert r.snapshot(last=0) == []  # not the whole ring ([-0:] trap)
+
+
+def test_obs_overhead_smoke():
+    """Tier-1 floor on the telemetry hot paths, on a FAKE clock so the
+    measured wall time is pure bookkeeping (no perf_counter jitter in
+    the observed values; the wall timer brackets the whole loop). The
+    floors are far below a healthy host's rate (counters measure
+    millions/s, trace hundreds of thousands/s) — they catch a
+    pathological regression (an accidental lock, an O(n) snapshot on
+    the write path), not a slow CI minute."""
+    import time as _time
+
+    from ripplemq_tpu.obs.metrics import Metrics
+    from ripplemq_tpu.obs.trace import FlightRecorder
+
+    m = Metrics(clock=lambda: 0.0)
+    c = m.counter("hot")
+    h = m.histogram("hot_us")
+    n = 200_000
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        c.inc()
+    counter_rate = n / (_time.perf_counter() - t0)
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        h.observe_int(123)
+    hist_rate = n / (_time.perf_counter() - t0)
+    r = FlightRecorder(capacity=1024, clock=lambda: 0.0)
+    nr = 50_000
+    t0 = _time.perf_counter()
+    for i in range(nr):
+        r.record("dispatch", seq=i, rounds=1, slots=2)
+    trace_rate = nr / (_time.perf_counter() - t0)
+    assert counter_rate > 250_000, f"counter inc at {counter_rate:.0f}/s"
+    assert hist_rate > 250_000, f"histogram observe at {hist_rate:.0f}/s"
+    assert trace_rate > 100_000, f"trace append at {trace_rate:.0f}/s"
+
+
+# --------------------------------------------------- postmortem (admin RPC)
+
+
+def test_postmortem_reconstructs_term_skew_signature():
+    """ISSUE 5 acceptance: the PR 4 device-term-skew wedge signature —
+    control-table term BEHIND the device current_term, nonzero
+    dispatches, zero commits on the wedged slot — reconstructed from
+    `admin.postmortem` output ALONE (no reach-ins, no debugger). The
+    wedge recipe is tests/test_term_skew.py's: a device election whose
+    OP_SET_LEADER advert never lands. The PR 4 self-heal would repair
+    the wedge within seconds (tests/test_term_skew.py proves that), so
+    the controller duty's election gate is frozen after bootstrap —
+    this test is about DIAGNOSIS of the persisting state, not repair."""
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_config(
+        3, topics=(Topic("t", 1, 3),),
+        metadata_election_timeout_s=0.6,
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl_id = next(iter(c.brokers.values())).manager.current_controller()
+        ctrl = c.brokers[ctrl_id]
+        dp = ctrl.dataplane
+        assert dp is not None
+        # Freeze the self-heal (needs_elections drives the duty's
+        # plan_elections pass): the wedge must persist for diagnosis.
+        ctrl.manager.needs_elections = lambda: False
+        a = ctrl.manager.assignment_of(("t", 0))
+        leader_slot = int(dp.leader[0])
+
+        def pm_engine():
+            pm = client.call(ctrl.addr, {"type": "admin.postmortem"},
+                             timeout=15.0)
+            assert pm["ok"], pm
+            return pm["engine"]
+
+        eng = pm_engine()
+        assert eng["term_skew_slots"] == []
+        commit_before = eng["device_commit"][0]
+
+        # Fabricate the wedge: the device grants a higher term, the
+        # advert is lost (we never propose OP_SET_LEADER).
+        skew_term = a.term + 3
+        won = dp.elect({0: (leader_slot, skew_term)})
+        assert won[0]
+        dispatches_before = dp.dispatches
+        # Rounds now dispatch at the stale table term and are refused.
+        import pytest as _pytest
+
+        from ripplemq_tpu.broker.dataplane import NotCommittedError
+        with _pytest.raises(NotCommittedError):
+            dp.submit_append(0, [b"wedged"]).result(timeout=30)
+
+        eng = pm_engine()
+        # The signature, from the bundle alone:
+        assert eng["term_skew_slots"] == [0]
+        assert eng["ctrl_table"]["term"][0] < eng["device_current_terms"][0]
+        assert eng["device_current_terms"][0] == skew_term
+        assert eng["counters"]["dispatches"] > dispatches_before
+        assert eng["device_commit"][0] == commit_before  # zero new commits
+        assert eng["stall_streaks"].get("0", 0) >= dp.max_retry_rounds
+        # And the flight recorder holds the causal history: the election
+        # that bumped the device term, then dispatches with no
+        # settle_release for the wedged rounds.
+        pm = client.call(ctrl.addr, {"type": "admin.postmortem"},
+                         timeout=15.0)
+        types = [e["type"] for e in pm["trace"]]
+        assert "elect" in types and "dispatch" in types
+
+
+def test_postmortem_settled_gaps_and_settle_window():
+    """The bundle carries the read-safety state PR 4 built (settled
+    gaps) and the settle-window occupancy — checked against the plane's
+    own accessors on a quiet cluster."""
+    with InProcCluster(make_config(3)) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        ctrl = next(b for b in c.brokers.values() if b.is_controller)
+        dp = ctrl.dataplane
+        with dp._lock:
+            dp._add_settled_gap_locked(1, 8, 16)
+        pm = client.call(ctrl.addr, {"type": "admin.postmortem"},
+                         timeout=15.0)
+        eng = pm["engine"]
+        assert eng["settled_gaps"] == {"1": [[8, 16]]}
+        assert eng["settle"]["window"] == dp.settle_window
+        assert eng["retry_budget"]["max_retry_rounds"] == dp.max_retry_rounds
+        # The gap creation is also a trace event.
+        types = [e["type"] for e in pm["trace"]]
+        assert "settled_gap" in types
+
+
+# ------------------------------------------------------------- JSON logging
+
+
+def test_configure_logging_json_lines():
+    """The structured mode: one JSON object per record with broker id,
+    subsystem, level, thread, and message as fields (what the proc
+    chaos backend launches its subprocess brokers with)."""
+    import io
+    import json as _json
+
+    from ripplemq_tpu.utils.logs import configure_logging, get_logger
+
+    buf = io.StringIO()
+    try:
+        configure_logging("INFO", stream=buf, json_lines=True, broker_id=7)
+        get_logger("dataplane").info("hello %s", "world")
+        get_logger("broker").warning("trouble at %d", 42)
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 2
+        docs = [_json.loads(ln) for ln in lines]
+        assert docs[0]["subsystem"] == "dataplane"
+        assert docs[0]["broker"] == 7
+        assert docs[0]["level"] == "INFO"
+        assert docs[0]["msg"] == "hello world"
+        assert docs[0]["thread"]
+        assert isinstance(docs[0]["ts"], float)
+        assert docs[1]["subsystem"] == "broker"
+        assert docs[1]["level"] == "WARNING"
+        assert docs[1]["msg"] == "trouble at 42"
+    finally:
+        # Restore the default pattern for the rest of the session.
+        configure_logging("WARNING")

@@ -33,7 +33,8 @@ def test_fixed_seed_proc_chaos_smoke(seed):
 
     verdict = run_chaos(seed=seed, phases=PHASES, phase_s=0.8,
                         ops_per_phase=2, backend="proc",
-                        converge_timeout_s=120.0)
+                        converge_timeout_s=120.0,
+                        include_postmortems=True, include_timeline=True)
     assert verdict["violations"] == [], (
         f"seed {seed} safety violations: {verdict['violations']}\n"
         f"trace: {trace_json(verdict['trace'])}\n"
@@ -49,6 +50,19 @@ def test_fixed_seed_proc_chaos_smoke(seed):
     sched = make_schedule(seed, [0, 1, 2], PHASES, ops_per_phase=2,
                           backend="proc")
     assert trace_json(verdict["trace"]) == trace_json(expected_trace(sched))
+    # Telemetry-plane acceptance on the PROCESS backend: the postmortem
+    # bundles traveled over real TCP from real broker subprocesses (the
+    # RPC surface, not an in-proc reach-in), and the merged timeline
+    # carries both nemesis fault ops and broker lifecycle events.
+    assert verdict["postmortems"], "no postmortem bundles collected"
+    for bid, pm in verdict["postmortems"].items():
+        assert pm["ok"] and pm["broker"] == int(bid)
+        assert "metrics" in pm and "trace" in pm
+    assert any(pm["engine"] is not None
+               for pm in verdict["postmortems"].values())
+    assert any(e.get("src") == "nemesis" for e in verdict["timeline"])
+    assert any(str(e.get("src", "")).startswith("broker")
+               for e in verdict["timeline"])
 
 
 def test_proc_schedule_purity_and_disk_op_targets():

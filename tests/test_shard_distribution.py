@@ -27,16 +27,7 @@ from ripplemq_tpu.storage.erasure import (
 from ripplemq_tpu.storage.segment import SegmentStore, scan_store
 from ripplemq_tpu.wire.transport import InProcNetwork
 from tests.broker_harness import make_config
-from tests.helpers import small_cfg
-
-
-def wait_until(pred, timeout=30.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
+from tests.helpers import small_cfg, wait_until
 
 
 def _fill_store(store_dir, records=40, payload=2000):

@@ -17,16 +17,7 @@ import pytest
 
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
-from tests.helpers import small_cfg
-
-
-def wait_until(pred, timeout=60.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
+from tests.helpers import small_cfg, wait_until
 
 
 @pytest.fixture()
@@ -149,7 +140,8 @@ def test_soak_ring_wrap_failover_zero_loss(cluster4):
     client = c.client()
     assert wait_until(
         lambda: len(next(iter(c.brokers.values()))
-                    .manager.current_standbys()) >= 2
+                    .manager.current_standbys()) >= 2,
+        timeout=60,
     ), "standby set never formed"
 
     acked: list[bytes] = []
@@ -185,10 +177,12 @@ def test_soak_ring_wrap_failover_zero_loss(cluster4):
     dead.add(ctrl)
     c.brokers[ctrl].stop()
     assert wait_until(
-        lambda: survivor.manager.current_controller() != ctrl
+        lambda: survivor.manager.current_controller() != ctrl,
+        timeout=60,
     ), "controller never moved"
     new_ctrl = survivor.manager.current_controller()
-    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None)
+    assert wait_until(lambda: c.brokers[new_ctrl].dataplane is not None,
+                      timeout=60)
     # The promoted standby replayed a WRAPPED store: its data plane's
     # trim watermark is active for the busy partitions.
     assert wait_until(

@@ -35,16 +35,7 @@ import pytest
 from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.metadata.models import Topic
 from tests.broker_harness import InProcCluster, make_config
-from tests.helpers import small_cfg
-
-
-def wait_until(pred, timeout=60.0, interval=0.05):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return False
+from tests.helpers import small_cfg, wait_until
 
 
 # ------------------------------------------------------- dataplane probe
@@ -236,4 +227,5 @@ def test_device_term_skew_self_heals(cluster3):
     assert int(dp.term[0]) == skew_term
     assert int(dp.current_terms()[0]) == skew_term  # device never re-bumped
     # The probe drains once rounds commit again.
-    assert wait_until(lambda: dp.stalled_slots(threshold=1) == [])
+    assert wait_until(lambda: dp.stalled_slots(threshold=1) == [],
+                      timeout=60)
