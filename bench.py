@@ -32,7 +32,14 @@ Field map:
   dispatch on the hot path; see broker/dataplane.py).
 - `spmd_parity` — local (vmap) vs spmd (shard_map, 1x1 mesh) dispatch
   on the same chip; delta_pct must stay small for the production
-  binding to be trusted at the local binding's numbers.
+  binding to be trusted at the local binding's numbers. The spmd arm
+  runs the FUSED control binding (the production default) with the
+  legacy-control shard_map binding recorded as the A/B arm.
+- `spmd_scaling` — sustained fused-spmd committed appends/s with
+  partitions sharded over the "part" mesh axis at 1/2/4/8 devices
+  (virtual CPU mesh, one subprocess per count; the virtual devices
+  share one host's FLOPs, so the curve prices sharding overhead, not
+  added silicon — profiles/spmd_scaling.py is the standalone harness).
 - `control_fusion_ab` — same-process A/B of the fused-control and
   packed-write levers (EngineConfig.fused_control / .packed_writes)
   vs the legacy path: control-only ms/round, full and quarter-batch
@@ -619,6 +626,12 @@ def _run_spmd_parity(chain: int = 8, launches: int = 240) -> dict:
     tests and dryrun_multichip; this is the single-chip-provable
     slice).
 
+    The spmd arm runs the FUSED control binding — the one production
+    runs now that make_spmd_fns honors fused_control (ISSUE 6) — with
+    the legacy-control shard_map binding kept as a recorded A/B arm
+    (`spmd_legacy_appends_per_sec`); `delta_pct` stays spmd-vs-local so
+    the trajectory's r5 figure remains comparable.
+
     Inputs are COMMITTED to each binding's expected sharding before the
     timed window (for the 1x1 mesh, fully replicated NamedSharding).
     Passing device arrays with unspecified sharding instead makes every
@@ -628,6 +641,8 @@ def _run_spmd_parity(chain: int = 8, launches: int = 240) -> dict:
     bindings ingest identically). r4's +1.29% figure hid the same
     artifact differently: its burst windows were dominated by a fixed
     window cost shared by both bindings (PROFILE.md r5)."""
+    import dataclasses
+
     import jax
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as _P
@@ -641,6 +656,7 @@ def _run_spmd_parity(chain: int = 8, launches: int = 240) -> dict:
         partitions=1024, replicas=1, slots=12352, slot_bytes=128,
         max_batch=256, read_batch=32, max_consumers=64, max_offset_updates=8,
     )
+    cfg_fused = dataclasses.replace(cfg, fused_control=True)
     B = cfg.max_batch
     one = build_step_input(cfg, appends={p: [PAYLOAD] * B
                                          for p in range(cfg.partitions)},
@@ -655,7 +671,8 @@ def _run_spmd_parity(chain: int = 8, launches: int = 240) -> dict:
     rep = NamedSharding(mesh, _P())  # 1x1 mesh: everything replicated
     bindings = {
         "local": (make_local_fns(cfg), None),
-        "spmd": (make_spmd_fns(cfg, mesh), rep),
+        "spmd": (make_spmd_fns(cfg_fused, mesh), rep),
+        "spmd_legacy": (make_spmd_fns(cfg, mesh), rep),
     }
     # Tunnel throughput varies ~2x between measurement windows, which
     # would swamp a single-shot A/B. ALTERNATE the bindings across
@@ -688,10 +705,84 @@ def _run_spmd_parity(chain: int = 8, launches: int = 240) -> dict:
     # write work dominates the round. Trust criterion: delta_pct > -20
     # at this maximally-exposed shape (PROFILE.md r5).
     delta = (best["spmd"] - best["local"]) / best["local"]
+    fused_delta = (best["spmd"] - best["spmd_legacy"]) / best["spmd_legacy"]
     return {
         "local_appends_per_sec": round(best["local"], 1),
         "spmd_appends_per_sec": round(best["spmd"], 1),
+        "spmd_binding": "fused_control",
+        "spmd_legacy_appends_per_sec": round(best["spmd_legacy"], 1),
+        "fused_vs_legacy_spmd_delta_pct": round(100 * fused_delta, 2),
         "delta_pct": round(100 * delta, 2),
+    }
+
+
+def _run_spmd_scaling(device_counts: tuple[int, ...] = (1, 2, 4, 8),
+                      chain: int = 8, launches: int = 24,
+                      windows: int = 2) -> dict:
+    """Per-device-count scaling curve for the production (fused) SPMD
+    binding: sustained committed appends/s with partitions sharded over
+    the "part" mesh axis at 1/2/4/8 devices — one SUBPROCESS per count
+    on a virtual CPU mesh (XLA_FLAGS device-count forcing, the same
+    technique as __graft_entry__.dryrun_multichip, so it runs
+    identically whether the parent bench sits on a TPU or a CPU host).
+    Each point is the SAME sustained best-of-N method as the headline:
+    the child (profiles/spmd_scaling.py --inner) imports
+    _sustained_window/_stage_trims from this module and tail-verifies
+    the ring after its best window.
+
+    HONESTY: the virtual devices share ONE host's FLOPs and memory
+    bandwidth, so this curve measures what sharding COSTS (collective,
+    dispatch, and output-gather overhead as the mesh widens) — not what
+    added silicon buys. A flat-ish curve means the sharded program
+    wastes nothing; the real speedup curve needs a pod slice (the
+    ROADMAP's carried v5e visit runs profiles/spmd_scaling.py there)."""
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "profiles", "spmd_scaling.py")
+    points = []
+    for n in device_counts:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        env = dict(
+            os.environ,
+            XLA_FLAGS=(
+                f"{flags} --xla_force_host_platform_device_count={n}"
+            ).strip(),
+            JAX_PLATFORMS="cpu",
+        )
+        res = subprocess.run(
+            [sys.executable, script, "--inner", str(n),
+             "--chain", str(chain), "--launches", str(launches),
+             "--windows", str(windows)],
+            env=env, capture_output=True, text=True,
+        )
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"spmd_scaling devices={n} failed rc={res.returncode}: "
+                f"{res.stderr[-2000:]}"
+            )
+        line = [ln for ln in res.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        points.append(json.loads(line))
+    base = points[0]["appends_per_sec"]
+    return {
+        "config": (f"P={points[0]['partitions']} R=1 "
+                   f"B={points[0]['max_batch']} chain={chain} sustained "
+                   f"fused-spmd, partitions sharded over 'part'"),
+        "method": ("one subprocess per device count on a virtual CPU "
+                   "mesh; virtual devices share one host's FLOPs, so "
+                   "this prices sharding overhead, not added silicon"),
+        "points": points,
+        "vs_1dev": {
+            str(p["devices"]): round(p["appends_per_sec"] / base, 3)
+            for p in points
+        },
     }
 
 
@@ -1242,6 +1333,9 @@ def main() -> None:
     )
     consume_rate = _run_consume(consume_cfg, consumers=32, rows_per_part=128)
     spmd = _run_spmd_parity(launches=parity_launches)
+    # Scale-out curve (always on the virtual CPU mesh — subprocesses
+    # force their own device counts regardless of the parent backend).
+    spmd_scaling = _run_spmd_scaling()
     # ISSUE 1 tentpole A/B: fused control + packed writes vs the legacy
     # path, same process, headline shape (also runnable standalone:
     # profiles/control_ab.py).
@@ -1271,6 +1365,7 @@ def main() -> None:
                 "operating_curve": curve,
                 "consume_msgs_per_sec": round(consume_rate, 1),
                 "spmd_parity": spmd,
+                "spmd_scaling": spmd_scaling,
                 "control_fusion_ab": fusion_ab,
                 "codec_mb_per_sec": codec_stats["codec_mb_per_sec"],
                 "codec_ab": codec_stats,

@@ -1,0 +1,158 @@
+"""One-command harness for the SPMD scaling curve (ISSUE 6 tentpole).
+
+Measures the production (fused-control) shard_map binding's sustained
+committed appends/s with partitions sharded over the "part" mesh axis,
+at several device counts, each count in its OWN subprocess on a virtual
+CPU mesh (XLA_FLAGS device-count forcing must precede JAX backend init,
+so counts cannot share a process). Every point uses the SAME sustained
+best-of-N method as bench.py's headline — `_sustained_window` ring-wraps
+behind staged trim watermarks and the best window's ring tail is
+byte-verified after the clock stops.
+
+Run:
+  python profiles/spmd_scaling.py                     # counts 1,2,4,8
+  python profiles/spmd_scaling.py --counts 1,4 --launches 48
+
+Prints one JSON line (the same dict bench.py embeds as `spmd_scaling`)
+plus a readable table.
+
+HONESTY: virtual devices share ONE host's FLOPs and memory bandwidth.
+This curve prices what sharding COSTS (collectives, dispatch, the
+output-gather psum) as the mesh widens — it cannot show what added
+silicon buys. On a real pod slice (the ROADMAP's carried v5e visit) the
+same command, minus the virtual-device forcing, measures the true
+speedup curve.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Runnable as `python profiles/spmd_scaling.py`: the repo root (where
+# `ripplemq_tpu` and `bench` live) is this file's parent directory.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The measured shape: R=1 isolates the partition-scale-out axis (the
+# replica axis is the parity A/B's job — bench._run_spmd_parity), P
+# divides every measured device count, and the stride sits below the
+# aliasing band at every shard width (core.config.stride_alias_hazard).
+SHAPE = dict(
+    partitions=256, replicas=1, slots=2496, slot_bytes=128,
+    max_batch=64, read_batch=32, max_consumers=64, max_offset_updates=8,
+    fused_control=True, packed_writes=True,
+)
+
+
+def run_inner(devices: int, chain: int, launches: int,
+              windows: int) -> dict:
+    """One scaling point, in-process. The caller must ALREADY have
+    forced `devices` virtual CPU devices via XLA_FLAGS (bench's
+    _run_spmd_scaling and this script's orchestrator both do)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from bench import (
+        PAYLOAD,
+        _stage_trims,
+        _sustained_warmup,
+        _sustained_window,
+        _verify_ring_tail,
+    )
+    from ripplemq_tpu.core.config import EngineConfig
+    from ripplemq_tpu.core.encode import build_step_input
+    from ripplemq_tpu.parallel.engine import make_spmd_fns, spmd_arg_shardings
+    from ripplemq_tpu.parallel.mesh import make_mesh
+
+    have = len(jax.devices())
+    assert have >= devices, (
+        f"need {devices} devices, have {have}: set XLA_FLAGS="
+        f"--xla_force_host_platform_device_count={devices} before JAX "
+        f"initializes (run via bench._run_spmd_scaling or this script's "
+        f"orchestrator, not --inner directly)"
+    )
+    cfg = EngineConfig(**SHAPE)
+    mesh = make_mesh(1, devices)
+    fns = make_spmd_fns(cfg, mesh)
+    B = cfg.max_batch
+    one = build_step_input(
+        cfg, appends={p: [PAYLOAD] * B for p in range(cfg.partitions)},
+        leader=0, term=1,
+    )
+    chained = jax.tree.map(
+        lambda x: np.broadcast_to(x, (chain,) + x.shape).copy(), one
+    )
+    # Commit every argument to its compiled sharding before the timed
+    # window (uncommitted shardings re-resolve per dispatch — the -12%
+    # bench artifact _run_spmd_parity documents).
+    sh = spmd_arg_shardings(mesh, chain=True)
+    inp = jax.tree.map(jax.device_put, chained, sh["inp"])
+    alive = jax.device_put(
+        np.ones((cfg.partitions, cfg.replicas), bool), sh["alive"]
+    )
+    quorum = jax.device_put(
+        np.full((cfg.partitions,), cfg.quorum, np.int32), sh["quorum"]
+    )
+    adv = chain * B
+    trims = _stage_trims(cfg, adv, launches,
+                         lambda x: jax.device_put(x, sh["trim"]))
+    _sustained_warmup(fns, inp, alive, quorum, trims)
+    best = 0.0
+    for _ in range(windows):
+        rate, state = _sustained_window(
+            fns, inp, alive, quorum, trims,
+            launches * adv * cfg.partitions,
+        )
+        if rate > best:
+            best = rate
+            _verify_ring_tail(fns, state, total_rows=launches * adv,
+                              batch=B, adv_round=B, nparts=cfg.partitions)
+        del state
+    return {
+        "devices": devices,
+        "local_P": cfg.partitions // devices,
+        "partitions": cfg.partitions,
+        "max_batch": B,
+        "appends_per_sec": round(best, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--counts", default="1,2,4,8",
+                    help="comma-separated device counts")
+    ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--launches", type=int, default=24)
+    ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--inner", type=int, default=None,
+                    help=argparse.SUPPRESS)  # child mode: one point
+    args = ap.parse_args()
+
+    if args.inner is not None:
+        print(json.dumps(run_inner(args.inner, args.chain, args.launches,
+                                   args.windows)))
+        return
+
+    from bench import _run_spmd_scaling
+
+    out = _run_spmd_scaling(
+        device_counts=tuple(int(c) for c in args.counts.split(",")),
+        chain=args.chain, launches=args.launches, windows=args.windows,
+    )
+    print(json.dumps(out))
+    print(f"\n{out['config']}", file=sys.stderr)
+    for p in out["points"]:
+        speed = out["vs_1dev"][str(p["devices"])]
+        print(f"  devices={p['devices']:<2d} local_P={p['local_P']:<4d} "
+              f"{p['appends_per_sec']:>14,.1f} appends/s  "
+              f"x{speed} vs 1 device", file=sys.stderr)
+    print(f"  note: {out['method']}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,7 @@ from ripplemq_tpu.metadata.models import (
     GroupKey,
     PartitionAssignment,
     Topic,
+    placement_only,
     topics_from_wire,
     topics_to_wire,
 )
@@ -175,7 +176,9 @@ class PartitionManager:
             self.controller_epoch = int(state.get("controller_epoch", 0))
             self.standbys = tuple(int(b) for b in state.get("standbys", ()))
             self._apply_set_topics(
-                topics_from_wire(state["topics"]), [int(b) for b in state["live"]]
+                topics_from_wire(state["topics"]),
+                [int(b) for b in state["live"]],
+                full_surface=True,
             )
 
     def _apply_set_controller(
@@ -213,33 +216,51 @@ class PartitionManager:
             slot = free[0]
         self.consumers[name] = slot
 
-    def _apply_set_topics(self, topics: list[Topic], live: list[int]) -> None:
+    def _apply_set_topics(self, topics: list[Topic], live: list[int],
+                          *, full_surface: bool = False) -> None:
         old_alive = self._alive_mask() if self.dataplane is not None else None
-        # Term-monotonic merge: the incoming assignment surface is a
-        # SNAPSHOT taken at proposal time on the metadata leader; an
-        # election that applied between snapshot and here would be
-        # reverted by installing it verbatim, regressing the advertised
-        # term below the device current_term (the permanent write wedge
-        # the chaos plane caught — no later election fires because the
-        # leader looks alive). Keep the newer (leader, term) wherever
-        # the current table is ahead; deterministic, so every replica's
-        # apply converges identically.
+        # OP SPLIT (PR 4 residual, load-bearing once placement moves
+        # across mesh shards): OP_SET_TOPICS owns PLACEMENT only. The
+        # (leader, term) surface belongs entirely to OP_SET_LEADER, so
+        # an apply here sources it from the replicated CURRENT table —
+        # whatever the payload carries is ignored (proposals strip it
+        # anyway, metadata.models.placement_only). A stale topics
+        # snapshot therefore can never regress the advertised term below
+        # the device current_term (the permanent write wedge the chaos
+        # plane caught), by construction rather than by merge. The
+        # current table is replicated state, so every broker's apply
+        # converges identically. A leader whose broker left the replica
+        # set becomes unknown (the partition re-elects); its term is
+        # kept — terms only move forward.
+        #
+        # `full_surface=True` is the SNAPSHOT-INSTALL path (restore):
+        # a snapshot is the full applied state at a log index and must
+        # carry leaders/terms; the original term-monotonic merge guards
+        # it against a current table that is already ahead.
         merged: list[Topic] = []
         for t in topics:
             cur = next((c for c in self.topics if c.name == t.name), None)
-            if cur is None:
-                merged.append(t)
-                continue
             assigns = list(t.assignments)
             for j, a in enumerate(assigns):
-                ca = cur.assignment_for(a.partition_id)
-                if ca is None or ca.term <= a.term:
-                    continue
-                keep = ca.leader if (ca.leader is None
-                                     or ca.leader in a.replicas) else None
-                assigns[j] = dataclasses.replace(
-                    a, leader=keep, term=ca.term
-                )
+                ca = cur.assignment_for(a.partition_id) if cur else None
+                if full_surface:
+                    if ca is None or ca.term <= a.term:
+                        continue
+                    keep = ca.leader if (ca.leader is None
+                                         or ca.leader in a.replicas) else None
+                    assigns[j] = dataclasses.replace(
+                        a, leader=keep, term=ca.term
+                    )
+                elif ca is None:
+                    # New partition: no leader until OP_SET_LEADER.
+                    assigns[j] = dataclasses.replace(a, leader=None, term=0)
+                else:
+                    keep = (ca.leader
+                            if ca.leader is not None
+                            and ca.leader in a.replicas else None)
+                    assigns[j] = dataclasses.replace(
+                        a, leader=keep, term=ca.term
+                    )
             merged.append(t.with_assignments(tuple(assigns)))
         topics = merged
         self.topics = topics
@@ -485,12 +506,16 @@ class PartitionManager:
                     return None
                 return {
                     "op": OP_SET_TOPICS,
-                    "topics": topics_to_wire(self.topics),
+                    # Placement-only payload (metadata.models.placement_only):
+                    # the (leader, term) surface is OP_SET_LEADER's domain,
+                    # so a proposal snapshot can never carry — and a racing
+                    # apply can never revert — an election's advert.
+                    "topics": topics_to_wire(placement_only(self.topics)),
                     "live": sorted(alive_brokers),
                 }
             return {
                 "op": OP_SET_TOPICS,
-                "topics": topics_to_wire(new_topics),
+                "topics": topics_to_wire(placement_only(new_topics)),
                 "live": sorted(alive_brokers),
             }
 

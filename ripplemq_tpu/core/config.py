@@ -39,8 +39,14 @@ ROW_HEADER = 8
 # "near" band is 1/16 relative.
 STRIDE_POW2_FLOOR = 1 << 20
 _STRIDE_REL_TOL = 16  # flag within pow2/16 of the power of two
-STRIDE_WARN_MIN_PARTITIONS = 64  # below this, too few concurrent
-#                                  strided streams to alias measurably
+# Below this many partition rings RESIDENT ON ONE DEVICE there are too
+# few concurrent strided streams to alias measurably. The count is a
+# per-device property, not a config property: the local (vmap) binding
+# keeps every replica's rings on one chip (partitions * replicas
+# streams), while the spmd binding's devices each hold ONE replica's
+# shard (partitions / part_shards streams — parallel.engine re-prices
+# the hazard there, since the config cannot know the mesh).
+STRIDE_WARN_MIN_PARTITIONS = 64
 
 
 def ring_stride_bytes(slots: int, max_batch: int, slot_bytes: int) -> int:
@@ -49,11 +55,23 @@ def ring_stride_bytes(slots: int, max_batch: int, slot_bytes: int) -> int:
     return (slots + max_batch) * slot_bytes
 
 
-def stride_alias_hazard(slots: int, max_batch: int,
-                        slot_bytes: int) -> str | None:
+def stride_alias_hazard(slots: int, max_batch: int, slot_bytes: int,
+                        streams: int | None = None) -> str | None:
     """Non-None iff the ring stride lands on/near a >= 2^20 power of two
     (the HBM-channel-aliasing shapes PROFILE.md r5 measured). Returns the
-    warning text so callers can warn, log, or assert on it."""
+    warning text so callers can warn, log, or assert on it.
+
+    `streams` is the number of partition rings resident on ONE device —
+    the concurrent strided-DMA streams that actually hammer the HBM
+    channels. Below STRIDE_WARN_MIN_PARTITIONS the aliasing is
+    unmeasurable and the verdict is None regardless of the stride:
+    pricing the GLOBAL partition count instead gets sharded deployments
+    wrong in both directions (a P=1024 config sharded 32 ways leaves 32
+    rings per device — clean — while a P=32 R=3 local binding keeps 96
+    rings on one chip — hazardous). None = stride-only verdict (the
+    caller applies its own stream gate)."""
+    if streams is not None and streams < STRIDE_WARN_MIN_PARTITIONS:
+        return None
     stride = ring_stride_bytes(slots, max_batch, slot_bytes)
     if stride <= 0:
         return None
@@ -99,9 +117,12 @@ class EngineConfig:
     # bit-identical to it (tests/test_control_fusion.py):
     fused_control: bool = False  # bookkeeping scalars as one [K, P] ctrl
     #                              array updated by wide fused ops instead
-    #                              of per-field element-wise ops (local
-    #                              binding; shard_map fusion is a ROADMAP
-    #                              open item)
+    #                              of per-field element-wise ops. Honored
+    #                              by BOTH bindings: under shard_map the
+    #                              stacked leader broadcast is ONE psum on
+    #                              the replica mesh axis per round (one
+    #                              ICI collective where the legacy control
+    #                              phase issues two)
     packed_writes: bool = False  # clip append DMA windows to the round's
     #                              payload extent instead of always moving
     #                              the full [B, SB] block
@@ -132,15 +153,19 @@ class EngineConfig:
             raise ValueError(f"slots must be a multiple of {ALIGN}")
         # The aliasing penalty comes from MANY concurrent strided
         # partition DMAs hammering the same HBM channels; at small
-        # partition counts the effect is negligible (the shipped P=8
-        # example keeps its round numbers on purpose — see
+        # per-device ring counts the effect is negligible (the shipped
+        # P=8 example keeps its round numbers on purpose — see
         # examples/cluster.yaml's sizing note), so only fan-out shapes
-        # warn.
-        if self.partitions >= STRIDE_WARN_MIN_PARTITIONS:
-            hazard = stride_alias_hazard(self.slots, self.max_batch,
-                                         self.slot_bytes)
-            if hazard is not None:
-                warnings.warn(hazard, UserWarning, stacklevel=2)
+        # warn. The stream count priced here is the DEFAULT local
+        # binding's: one device holds every replica's rings (P * R). A
+        # sharded deployment's devices hold only partitions/part_shards
+        # rings each — parallel.engine.make_spmd_fns re-prices the
+        # hazard at that per-device shard and is the authority there.
+        hazard = stride_alias_hazard(self.slots, self.max_batch,
+                                     self.slot_bytes,
+                                     streams=self.partitions * self.replicas)
+        if hazard is not None:
+            warnings.warn(hazard, UserWarning, stacklevel=2)
 
     @property
     def quorum(self) -> int:

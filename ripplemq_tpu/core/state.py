@@ -85,7 +85,16 @@ class FusedReplicaState(NamedTuple):
     The named accessors mirror ReplicaState so host-side readers
     (DataPlane._fetch_state, read paths, tests) work on either
     representation; they are views, not extra buffers. Conversion in
-    both directions is exact (`fuse_state` / `unfuse_state`)."""
+    both directions is exact (`fuse_state` / `unfuse_state`).
+
+    Under the spmd binding the engine-stacked ctrl is [R, K, P] sharded
+    ("replica", None, "part") — the K bookkeeping rows stay whole on
+    every device while replicas and partitions shard
+    (parallel.engine._fused_state_specs), which is what lets the round's
+    two leader broadcasts ride ONE [2, local_P] psum over the replica
+    mesh axis (one ICI collective where the legacy layout issues two)
+    and keeps the named-accessor views valid on process-sharded state
+    (the slice is along the unsharded K axis)."""
 
     log_data: jax.Array     # uint8 [P, S+B, SB] — identical to ReplicaState
     ctrl: jax.Array         # int32 [K, P]       — CTRL_FIELDS, stacked

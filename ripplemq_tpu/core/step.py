@@ -155,6 +155,12 @@ def replica_control(
     rows never land in the wrap margin — see core.state ring doc).
     """
     S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
+    # Shard-shape note: under shard_map this function sees [local_P]
+    # SHARDS of every per-partition argument, not the global [P] — all
+    # the arithmetic below is shape-agnostic, but these cfg.partitions-
+    # shaped defaults are NOT, so the spmd wrappers always pass quorum/
+    # trim explicitly (parallel.engine fills them before the smapped
+    # call). The defaults exist for the local binding and direct use.
     P = cfg.partitions
     if quorum is None:
         quorum = jnp.full((P,), cfg.quorum, jnp.int32)
@@ -281,6 +287,8 @@ def replica_control_fused(
     last_term keep their wrote_rows selects unchanged.
     """
     S, B, R = cfg.slots, cfg.max_batch, cfg.replicas
+    # Same shard-shape note as replica_control: [local_P] shards under
+    # shard_map; the spmd wrappers never rely on these [P] defaults.
     P = cfg.partitions
     if quorum is None:
         quorum = jnp.full((P,), cfg.quorum, jnp.int32)

@@ -130,6 +130,26 @@ class Topic:
         )
 
 
+def placement_only(topics: list[Topic] | tuple[Topic, ...]) -> list[Topic]:
+    """Strip the (leader, term) surface from every assignment.
+
+    OP_SET_TOPICS owns PLACEMENT only (broker.manager): its payload must
+    never carry a leader/term surface, because the payload is a snapshot
+    taken at proposal time on the metadata leader — an election that
+    applies between snapshot and apply would be reverted by installing
+    it, regressing the advertised term below the device current_term
+    (the permanent write wedge the chaos plane caught, PR 4). The
+    (leader, term) surface is owned entirely by OP_SET_LEADER; applies
+    source it from the replicated current table."""
+    return [
+        t.with_assignments(tuple(
+            dataclasses.replace(a, leader=None, term=0)
+            for a in t.assignments
+        ))
+        for t in topics
+    ]
+
+
 def topics_to_wire(topics: list[Topic] | tuple[Topic, ...]) -> list[dict]:
     return [t.to_dict() for t in topics]
 

@@ -18,14 +18,16 @@ Both produce bit-identical semantics (asserted in tests/test_spmd.py).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ripplemq_tpu.core.config import EngineConfig
+from ripplemq_tpu.core.config import EngineConfig, stride_alias_hazard
 from ripplemq_tpu.core.state import (
+    FusedReplicaState,
     ReplicaState,
     StepInput,
     StepOutput,
@@ -318,6 +320,22 @@ def _state_specs(cfg: EngineConfig) -> ReplicaState:
     )
 
 
+def _fused_state_specs(cfg: EngineConfig) -> FusedReplicaState:
+    """PartitionSpecs for the fused-control state (cfg.fused_control):
+    the stacked ctrl buffer is [R, K, P] — replica axis sharded, the K
+    bookkeeping rows replicated WITHIN a device, partition axis sharded
+    over "part". Each device then holds its shard's whole [K, local_P]
+    bookkeeping block, so a round's four scalar advances stay ONE wide
+    select on one local buffer and the two leader broadcasts ride ONE
+    [2, local_P] psum over the replica mesh axis (one ICI collective
+    where the legacy control phase issues two)."""
+    return FusedReplicaState(
+        log_data=P("replica", "part", None, None),
+        ctrl=P("replica", None, "part"),
+        offsets=P("replica", "part", None),
+    )
+
+
 def _input_specs() -> StepInput:
     """Inputs carry no replica axis: XLA's data distribution replicates
     them over the replica mesh axis (this IS the AppendEntries fan-out).
@@ -338,6 +356,32 @@ def _input_specs() -> StepInput:
 
 
 
+def spmd_arg_shardings(mesh: Mesh, chain: bool = False):
+    """NamedShardings for staging step arguments on an spmd mesh:
+    ``(inp, alive, quorum, trim)`` keyed by name. Bench/profile harnesses
+    COMMIT inputs to these before a timed window — device arrays with
+    unspecified shardings make every call re-resolve shardings on the
+    python dispatch path (measured -12% on the spmd side only,
+    bench._run_spmd_parity). The broker needs no staging (it hands the
+    binding fresh host numpy arrays each round); this is for resident-
+    input measurement loops. ``chain=True`` prefixes the unsharded chain
+    axis the step_many scan inputs carry."""
+    in_specs = _input_specs()
+    if chain:
+        in_specs = jax.tree.map(
+            lambda s: P(*((None,) + tuple(s))), in_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+    named = lambda s: NamedSharding(mesh, s)
+    return {
+        "inp": jax.tree.map(named, in_specs,
+                            is_leaf=lambda s: isinstance(s, P)),
+        "alive": named(P("part", None)),
+        "quorum": named(P("part")),
+        "trim": named(P("part")),
+    }
+
+
 def _smap(f, mesh, in_specs, out_specs):
     """shard_map with the varying-manual-axes checker off: the Pallas
     write kernel's out_shape carries no vma annotation, which newer JAX
@@ -355,20 +399,6 @@ def _smap(f, mesh, in_specs, out_specs):
 def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     R = cfg.replicas
     part_shards = mesh.shape["part"]
-    if cfg.fused_control:
-        # Control fusion under shard_map needs fused state specs plus a
-        # fused resync/fetch surface across processes — a ROADMAP open
-        # item. The flag is a perf hint with identical semantics, so the
-        # spmd binding keeps the legacy control phase rather than
-        # refusing to build. (packed_writes IS honored here.)
-        import warnings
-
-        warnings.warn(
-            "fused_control is not yet implemented for the spmd binding; "
-            "using the legacy control phase (same semantics)",
-            UserWarning,
-            stacklevel=2,
-        )
     if mesh.shape["replica"] != R:
         raise ValueError(
             f"mesh replica axis {mesh.shape['replica']} != cfg.replicas {R}"
@@ -377,7 +407,32 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         raise ValueError("partitions must divide evenly over the part axis")
     local_P = cfg.partitions // part_shards
 
-    st_specs = _state_specs(cfg)
+    # cfg.fused_control under shard_map: the same stacked-ctrl layout and
+    # fused ops as the local binding (core.step.replica_control_fused),
+    # with fused PartitionSpecs — the two leader broadcasts become ONE
+    # real [2, local_P] psum on the replica mesh axis (one ICI collective
+    # per round where the legacy control phase issues two). Bit-identical
+    # committed prefixes to both the legacy-spmd and fused-vmap paths
+    # (tests/test_spmd.py parity matrix).
+    fused = cfg.fused_control
+    ctrl_fn = (core_step.replica_control_fused if fused
+               else core_step.replica_control)
+    vote_fn = core_step.vote_step_fused if fused else core_step.vote_step
+
+    # The ring-stride aliasing rule priced at the PER-DEVICE shape: each
+    # mesh device holds ONE replica's [local_P, S+B, SB] ring block, so
+    # local_P is the concurrent strided-DMA stream count — the global-P
+    # verdict EngineConfig warns with at construction can be wrong in
+    # both directions for a sharded deployment (core.config).
+    hazard = stride_alias_hazard(cfg.slots, cfg.max_batch, cfg.slot_bytes,
+                                 streams=local_P)
+    if hazard is not None:
+        warnings.warn(
+            f"spmd binding: per-device shard holds {local_P} partition "
+            f"rings; {hazard}", UserWarning, stacklevel=2,
+        )
+
+    st_specs = _fused_state_specs(cfg) if fused else _state_specs(cfg)
     in_specs = _input_specs()
     rep_ids = jnp.arange(R, dtype=jnp.int32)
 
@@ -434,7 +489,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     # ---- step -------------------------------------------------------------
     def step_body(state, inp, rep, alive, quorum, trim):
         st = _squeeze(state)          # strip the size-1 replica block dim
-        new_st, ctl = core_step.replica_control(
+        new_st, ctl = ctrl_fn(
             cfg, st, inp, rep[0], alive, quorum, trim
         )
         # Write phase on this device's [1, P_local, S+B, SB] ring block.
@@ -510,7 +565,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     def step_sparse_body(state, inp, entries_c, slot_ids, rep, alive,
                          quorum, trim):
         st = _squeeze(state)
-        new_st, ctl = core_step.replica_control(
+        new_st, ctl = ctrl_fn(
             cfg, st, inp, rep[0], alive, quorum, trim
         )
         log_data = append_rows_active(
@@ -576,7 +631,7 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     # ---- vote -------------------------------------------------------------
     def vote_body(state, cand, cand_term, rep, alive, quorum):
         st = _squeeze(state)
-        new_st, elected, votes = core_step.vote_step(
+        new_st, elected, votes = vote_fn(
             cfg, st, cand, cand_term, rep[0], alive, quorum
         )
         elected, votes = _gather_part((elected, votes))
@@ -691,6 +746,13 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
     # ---- resync -----------------------------------------------------------
     def resync_body(state, rep, src, dst, part_mask):
         st = _squeeze(state)
+        if fused:
+            # The masking below assumes [local_P, ...] leaves; the fused
+            # ctrl leaf is [K, local_P]. Resync is the rare recovery
+            # path, so round-trip through the named layout (exact both
+            # ways) instead of teaching the masking about the stacked
+            # axis — the same trade the local binding makes.
+            st = unfuse_state(st)
         my_rep = rep[0]
         # broadcast src replica's masked rows to everyone, then overwrite dst
         def leaf(x):
@@ -700,7 +762,10 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
             )
             return jnp.where((my_rep == dst) & m, src_rows, x)
 
-        return _expand(jax.tree.map(leaf, st))
+        new_st = jax.tree.map(leaf, st)
+        if fused:
+            new_st = fuse_state(new_st)
+        return _expand(new_st)
 
     smapped_resync = _smap(
         resync_body,
@@ -714,7 +779,13 @@ def make_spmd_fns(cfg: EngineConfig, mesh: Mesh) -> SpmdEngineFns:
         return smapped_resync(state, rep_ids, src, dst, part_mask)
 
     # ---- init -------------------------------------------------------------
-    def _place(one: ReplicaState) -> ReplicaState:
+    def _place(one: ReplicaState):
+        """Install a single-replica image (always the NAMED layout — the
+        recovery path hands plain ReplicaStates) on every replica slot,
+        sharded per st_specs; fused configs stack the ctrl scalars
+        first so the placed state matches the compiled layout."""
+        if fused:
+            one = fuse_state(one)
         full = jax.tree.map(
             lambda x: jnp.broadcast_to(jnp.asarray(x), (R,) + jnp.asarray(x).shape),
             one,

@@ -277,7 +277,12 @@ class LockstepController:
         """Materialize one process-sharded state leaf on the host. The
         allgather is itself a global-mesh collective, so it must be
         broadcast like any other call — a bare np.asarray on the
-        controller would hang waiting for the workers."""
+        controller would hang waiting for the workers. Fused-control
+        states serve the named scalars (log_end/current_term/commit) as
+        ctrl-buffer views (core.state.FusedReplicaState properties) —
+        the slice is along the unsharded K axis, and controller and
+        workers launch the identical getattr, so the mesh stays in
+        lockstep for it like any other computation."""
 
         def local():
             from jax.experimental import multihost_utils

@@ -12,7 +12,12 @@ Two mesh axes:
   independent logs, no cross-partition collectives, so this axis only
   shards the leading P axis of the state (the reference's "many Raft
   groups multiplexed on one server", PartitionRaftServer.java:93, becomes
-  a sharded tensor axis).
+  a sharded tensor axis). Each device then holds local_P =
+  partitions / part_shards rings — the count that prices the HBM
+  stride-aliasing rule on that device (core.config.stride_alias_hazard;
+  make_spmd_fns re-checks it per shard) and the knob that scales P past
+  one chip's HBM. Sizing: part_shards must divide partitions evenly and
+  replicas * part_shards devices must exist (README "SPMD engine").
 """
 
 from __future__ import annotations

@@ -335,9 +335,13 @@ def test_spmd_packed_matches_local_legacy():
                                           log_s[r, p, :live])
 
 
-def test_spmd_fused_falls_back_with_warning():
-    """fused_control under shard_map is a ROADMAP open item: the binding
-    must warn and serve legacy-control semantics, not crash."""
+def test_spmd_fused_no_fallback_warning():
+    """The NEGATION of the pre-ISSUE-6 fallback assertion: fused_control
+    under shard_map is implemented — make_spmd_fns must honor it with NO
+    fallback UserWarning and serve committed rounds through the fused
+    control phase."""
+    import warnings
+
     import jax
 
     from ripplemq_tpu.parallel.engine import make_spmd_fns
@@ -346,12 +350,55 @@ def test_spmd_fused_falls_back_with_warning():
     if len(jax.devices()) < 3:
         pytest.skip("needs 3 virtual devices")
     cfg = _cfg("fused")
-    with pytest.warns(UserWarning, match="fused_control"):
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
         spmd = make_spmd_fns(cfg, make_mesh(cfg.replicas, 1))
+    assert not any("fused_control" in str(w.message) for w in rec), (
+        [str(w.message) for w in rec]
+    )
     st = spmd.init()
     inp = build_step_input(cfg, appends={0: [b"ok"]}, leader=0, term=1)
     st, out = spmd.step(st, inp, np.ones((3,), bool))
     assert bool(np.asarray(out.committed)[0])
+
+
+@pytest.mark.parametrize("name", ["fused", "fused+packed"])
+def test_spmd_fused_matches_local_legacy(name):
+    """The fused shard_map binding replayed against the LEGACY local
+    engine over the scripted history: same outputs, same scalar state,
+    same committed log prefix — the committed-prefix contract of the
+    ISSUE 6 tentpole, from the opposite direction of the spmd parity
+    matrix (which compares the three production bindings to each
+    other)."""
+    import jax
+
+    from ripplemq_tpu.parallel.engine import make_spmd_fns
+    from ripplemq_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 6:
+        pytest.skip("needs 6 virtual devices")
+    cfg = _cfg(name)
+    spmd = make_spmd_fns(cfg, make_mesh(cfg.replicas, 2))
+    local = make_local_fns(_cfg("legacy"))
+    ss, ls = spmd.init(), local.init()
+    for appends, _, leader, term, alive in SCRIPT:
+        inp = build_step_input(cfg, leader=leader, term=term, **appends)
+        ss, s_out = spmd.step(ss, inp, alive)
+        ls, l_out = local.step(ls, inp, alive)
+        _assert_tree_equal(l_out, s_out, f"spmd {name} out")
+    fs = unfuse_state(ss)
+    for f in ("log_end", "last_term", "current_term", "commit", "offsets"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ls, f)), np.asarray(getattr(fs, f)),
+            err_msg=f,
+        )
+    ends = np.asarray(ls.log_end)
+    log_l, log_s = np.asarray(ls.log_data), np.asarray(fs.log_data)
+    for r in range(cfg.replicas):
+        for p in range(cfg.partitions):
+            live = min(int(ends[r, p]), cfg.slots)
+            np.testing.assert_array_equal(log_l[r, p, :live],
+                                          log_s[r, p, :live])
 
 
 def test_init_from_image_parity():

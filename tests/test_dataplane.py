@@ -214,23 +214,29 @@ def test_plan_repairs_catches_slot_revived_while_leaderless():
     try:
         m = PartitionManager(0, config, dp)
 
-        def topics_with(leader, term):
+        def placement():
+            # OP_SET_TOPICS owns placement only; the (leader, term)
+            # surface rides OP_SET_LEADER (the op split — see
+            # tests/test_op_split.py for the directed coverage).
             return topics_to_wire([
                 t.with_assignments(tuple(
-                    PartitionAssignment(pid, (0, 1, 2), leader, term)
+                    PartitionAssignment(pid, (0, 1, 2), None, 0)
                     for pid in range(t.partitions)
                 ))
                 for t in config.topics
             ])
 
-        # Healthy cluster, leader broker 0 everywhere; commit a round.
-        m.apply(1, {"op": OP_SET_TOPICS, "topics": topics_with(0, 1),
+        # Healthy cluster; leader broker 0 advertised, commit a round.
+        m.apply(1, {"op": OP_SET_TOPICS, "topics": placement(),
                     "live": [0, 1, 2]})
+        m.apply(2, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
+                    "leader": 0, "term": 1})
         slot = m.slot_of(("topic1", 0))
         assert dp.submit_append(slot, [b"r1a", b"r1b"]).result(timeout=10) == 0
 
-        # Broker 2 dies; the quorum of {0, 1} keeps committing.
-        m.apply(2, {"op": OP_SET_TOPICS, "topics": topics_with(0, 1),
+        # Broker 2 dies; the quorum of {0, 1} keeps committing (the
+        # placement re-apply keeps the current leader surface).
+        m.apply(3, {"op": OP_SET_TOPICS, "topics": placement(),
                     "live": [0, 1]})
         dp.submit_append(slot, [b"r2"]).result(timeout=10)
         ends = dp.log_ends()
@@ -238,16 +244,16 @@ def test_plan_repairs_catches_slot_revived_while_leaderless():
 
         # Leader lost too: partition goes leaderless, THEN broker 2
         # revives. came-alive resync is skipped (no leader to copy from).
-        m.apply(3, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
+        m.apply(4, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
                     "leader": None, "term": 1})
-        m.apply(4, {"op": OP_SET_TOPICS, "topics": topics_with(None, 1),
+        m.apply(5, {"op": OP_SET_TOPICS, "topics": placement(),
                     "live": [0, 1, 2]})
         assert m.plan_repairs() == {}  # leaderless: nothing to plan yet
         ends = dp.log_ends()
         assert ends[2, slot] < ends[0, slot]  # still stale
 
         # Election lands: now the periodic repair pass must plan a resync.
-        m.apply(5, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
+        m.apply(6, {"op": OP_SET_LEADER, "topic": "topic1", "partition": 0,
                     "leader": 0, "term": 2})
         repairs = m.plan_repairs()
         assert any(slot in slots for (_, d), slots in repairs.items() if d == 2)

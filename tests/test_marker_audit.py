@@ -52,7 +52,9 @@ FAST_MODULES = {
     "test_marker_audit",
     "test_metadata",
     "test_model_check",
+    "test_multichip_smoke",     # tier-1 fused-spmd canary on the 8-dev mesh
     "test_observability",
+    "test_op_split",
     "test_packaging",
     "test_proc_chaos",          # ~2 min: 2-seed real-subprocess chaos smoke
     "test_process_cluster",     # ~20 s: real-subprocess broker boot

@@ -1,0 +1,184 @@
+"""Control-plane op split (ISSUE 6 satellite, PR 4 carried residual):
+`OP_SET_TOPICS` owns PLACEMENT only; the (leader, term) surface is owned
+entirely by `OP_SET_LEADER`.
+
+Before the split, a topics proposal snapshotted the whole assignment
+surface at proposal time on the metadata leader — an election that
+applied between snapshot and apply raced it, and only a term-monotonic
+merge kept the stale surface from regressing the advertised term below
+the device current_term (the permanent write wedge the chaos plane
+caught — see tests/test_term_skew.py). The split removes the race by
+construction: a topics payload CANNOT carry a leader/term surface at
+all (proposals strip it, metadata.models.placement_only), and the apply
+sources (leader, term) from the replicated current table. This becomes
+load-bearing once placement moves across mesh shards (rebalance under
+the consumer-group direction): placement rewrites must be frequent and
+leader-surface-neutral.
+
+Snapshot RESTORE is the one deliberate exception (`full_surface=True`):
+a metadata snapshot is the complete applied state at a log index and
+must install leaders — still term-monotonically merged against a
+current table that is ahead."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ripplemq_tpu.broker.manager import OP_SET_TOPICS, PartitionManager
+from ripplemq_tpu.metadata.models import (
+    PartitionAssignment,
+    Topic,
+    placement_only,
+    topics_from_wire,
+    topics_to_wire,
+)
+from tests.broker_harness import make_config
+
+
+def _mgr() -> PartitionManager:
+    # No dataplane: the op-split contract is pure metadata state.
+    return PartitionManager(0, make_config(3), dataplane=None)
+
+
+def _seed_topics(m: PartitionManager, leader: int = 0, term: int = 3) -> None:
+    """Install placement, then advertise leaders the owned way."""
+    m.apply(1, {
+        "op": OP_SET_TOPICS,
+        "topics": topics_to_wire([
+            t.with_assignments(tuple(
+                PartitionAssignment(pid, (0, 1, 2), None, 0)
+                for pid in range(t.partitions)
+            ))
+            for t in m.config.topics
+        ]),
+        "live": [0, 1, 2],
+    })
+    idx = 2
+    for t in m.config.topics:
+        for pid in range(t.partitions):
+            m.apply(idx, {"op": "set_leader", "topic": t.name,
+                          "partition": pid, "leader": leader, "term": term})
+            idx += 1
+
+
+def test_plan_assignment_payload_carries_no_leader_surface():
+    """Every OP_SET_TOPICS proposal — first boot AND membership change —
+    must be placement-only: no assignment may carry a leader or a
+    nonzero term."""
+    m = _mgr()
+    cmd = m.plan_assignment([0, 1, 2])  # first boot
+    assert cmd is not None and cmd["op"] == OP_SET_TOPICS
+    for t in topics_from_wire(cmd["topics"]):
+        for a in t.assignments:
+            assert a.leader is None and a.term == 0
+    m.apply(1, cmd)
+    _seed_topics(m)
+    cmd = m.plan_assignment([0, 1])  # membership change after elections
+    assert cmd is not None
+    for t in topics_from_wire(cmd["topics"]):
+        for a in t.assignments:
+            assert a.leader is None and a.term == 0
+
+
+def test_apply_ignores_any_payload_leader_surface():
+    """A topics payload that DOES carry a leader/term surface (a buggy
+    or pre-split proposer) must not install it — not even a HIGHER term:
+    the surface is sourced from the current table, unconditionally."""
+    m = _mgr()
+    m.apply(1, m.plan_assignment([0, 1, 2]))
+    _seed_topics(m, leader=0, term=3)
+    hostile = [
+        t.with_assignments(tuple(
+            dataclasses.replace(a, leader=2, term=99) for a in t.assignments
+        ))
+        for t in m.get_topics()
+    ]
+    m.apply(99, {"op": OP_SET_TOPICS, "topics": topics_to_wire(hostile),
+                 "live": [0, 1, 2]})
+    a = m.assignment_of(("topic1", 0))
+    assert a.leader == 0 and a.term == 3
+
+
+def test_stale_placement_snapshot_cannot_revert_election():
+    """The term-skew race the split closes: a placement proposal
+    snapshotted before an election applies AFTER it — the election's
+    (leader, term) must survive untouched."""
+    m = _mgr()
+    m.apply(1, m.plan_assignment([0, 1, 2]))
+    _seed_topics(m, leader=0, term=3)
+    stale = m.plan_assignment([0, 1]) or {
+        "op": OP_SET_TOPICS,
+        "topics": topics_to_wire(placement_only(m.get_topics())),
+        "live": [0, 1],
+    }
+    # Election races in between snapshot and apply.
+    m.apply(50, {"op": "set_leader", "topic": "topic1", "partition": 0,
+                 "leader": 1, "term": 7})
+    m.apply(51, stale)
+    a = m.assignment_of(("topic1", 0))
+    assert a.leader == 1 and a.term == 7
+
+
+def test_placement_move_drops_leader_keeps_term():
+    """A placement rewrite that removes the leader's broker from the
+    replica set leaves the partition leaderless (it re-elects) but keeps
+    the term — terms only move forward."""
+    m = _mgr()
+    m.apply(1, m.plan_assignment([0, 1, 2]))
+    _seed_topics(m, leader=2, term=4)
+    moved = [
+        t.with_assignments(tuple(
+            PartitionAssignment(a.partition_id, (0, 1, 3), None, 0)
+            for a in t.assignments
+        ))
+        for t in m.get_topics()
+    ]
+    m.apply(60, {"op": OP_SET_TOPICS, "topics": topics_to_wire(moved),
+                 "live": [0, 1, 3]})
+    a = m.assignment_of(("topic1", 0))
+    assert a.replicas == (0, 1, 3)
+    assert a.leader is None and a.term == 4
+
+
+def test_snapshot_restore_preserves_leader_surface():
+    """The deliberate exception: a metadata SNAPSHOT is the full applied
+    state and must install leaders on a fresh node (restore routes
+    through the full_surface path)."""
+    m = _mgr()
+    m.apply(1, m.plan_assignment([0, 1, 2]))
+    _seed_topics(m, leader=1, term=5)
+    snap = m.snapshot()
+    fresh = _mgr()
+    fresh.restore(snap)
+    a = fresh.assignment_of(("topic1", 0))
+    assert a.leader == 1 and a.term == 5
+
+
+def test_snapshot_restore_stays_term_monotonic():
+    """Restoring a snapshot onto a table that is already AHEAD (a node
+    that applied newer entries) must keep the newer (leader, term) — the
+    pre-split merge rule, still guarding the full-surface path."""
+    m = _mgr()
+    m.apply(1, m.plan_assignment([0, 1, 2]))
+    _seed_topics(m, leader=0, term=3)
+    snap = m.snapshot()
+    m.apply(90, {"op": "set_leader", "topic": "topic1", "partition": 0,
+                 "leader": 1, "term": 8})
+    m.restore(snap)
+    a = m.assignment_of(("topic1", 0))
+    assert a.leader == 1 and a.term == 8
+
+
+def test_placement_only_helper_strips_everything():
+    t = Topic("x", 2, 3, (
+        PartitionAssignment(0, (0, 1, 2), 2, 9),
+        PartitionAssignment(1, (1, 2, 3), None, 4),
+    ))
+    stripped = placement_only([t])[0]
+    assert [a.replicas for a in stripped.assignments] == [
+        (0, 1, 2), (1, 2, 3)
+    ]
+    assert all(a.leader is None and a.term == 0
+               for a in stripped.assignments)
+    # Input untouched (frozen models; no aliasing surprises).
+    assert t.assignments[0].leader == 2 and t.assignments[0].term == 9

@@ -81,3 +81,37 @@ def test_small_fanout_does_not_warn():
 def test_floor_constant_is_a_megabyte():
     # The rule's floor is load-bearing for the tests above; pin it.
     assert STRIDE_POW2_FLOOR == 1 << 20
+
+
+# --------------------------------------------------- per-device (sharded)
+
+
+def test_sharded_global_hazard_local_clean():
+    # A P=1024 config at the measured-bad stride flags when one device
+    # holds all 1024 rings — but sharded 32 ways each device holds 32
+    # concurrent streams, too few to alias: the per-device verdict must
+    # be clean (warning on the global shape would flag a layout no
+    # device actually holds).
+    assert stride_alias_hazard(8192, 256, 128, streams=1024) is not None
+    assert stride_alias_hazard(8192, 256, 128, streams=32) is None
+
+
+def test_local_hazard_global_clean():
+    # The inverse miss: the old gate priced cfg.partitions alone, but
+    # the LOCAL binding keeps every replica's rings on one chip. P=32
+    # R=3 puts 96 strided streams on the device — above the aliasing
+    # threshold although the partition count alone sits below it.
+    assert stride_alias_hazard(8192, 256, 128, streams=96) is not None
+    with pytest.warns(UserWarning, match="alias HBM channels"):
+        EngineConfig(partitions=32, replicas=3, slots=8192,
+                     slot_bytes=128, max_batch=256)
+
+
+def test_streams_gate_boundary():
+    # The gate is inclusive at STRIDE_WARN_MIN_PARTITIONS (the measured
+    # finding was well above it; the boundary itself must be stable).
+    bad = (8192, 256, 128)
+    assert stride_alias_hazard(*bad, streams=64) is not None
+    assert stride_alias_hazard(*bad, streams=63) is None
+    # streams only gates — it never turns a healthy stride hazardous.
+    assert stride_alias_hazard(12352, 256, 128, streams=4096) is None
