@@ -1194,6 +1194,102 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _run_group_consume(n_groups: int = 3, members: int = 2,
+                       partitions: int = 4, n_msgs: int = 600) -> dict:
+    """Multi-group drain (ISSUE 7): `n_groups` consumer groups, each of
+    `members` GroupConsumer members, independently drain the same
+    produced topic — the multi-tenant fan-out workload the group
+    coordinator opens (every group re-reads the full log through its
+    own shared offsets). COUNT-EXACT per group: a group finishing with
+    anything but exactly `n_msgs` delivered fails the bench. Runs on an
+    in-proc cluster (the coordinator + fencing + shared-offset path is
+    the subject; the TCP frame cost is e2e's)."""
+    import threading as _threading
+
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.client import GroupConsumer, ProducerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_cluster_config(
+        3, topics=(Topic("gbench", partitions, 3),),
+        engine=None,
+    )
+    with InProcCluster(config) as cluster:
+        cluster.wait_for_leaders()
+        bootstrap = [b.address for b in config.brokers]
+        producer = ProducerClient(
+            bootstrap, transport=cluster.client("gbench-p"),
+            rpc_timeout_s=10.0,
+        )
+        per_part = n_msgs // partitions
+        n_msgs = per_part * partitions
+        B = config.engine.max_batch
+        for pid in range(partitions):
+            payloads = [b"g-%d-%06d" % (pid, i) for i in range(per_part)]
+            for i in range(0, per_part, B):
+                producer.produce_batch("gbench", payloads[i : i + B],
+                                       partition=pid)
+        producer.close()
+
+        counts = {g: 0 for g in range(n_groups)}
+        lock = _threading.Lock()
+        stop = _threading.Event()
+
+        def member(gi: int, mi: int):
+            gc = GroupConsumer(
+                bootstrap, f"bg{gi}", topics=["gbench"],
+                member_id=f"m{mi}",
+                transport=cluster.client(f"gbench-{gi}-{mi}"),
+                heartbeat_s=0.5, rpc_timeout_s=10.0,
+            )
+            try:
+                gc.join()
+                while not stop.is_set():
+                    _, msgs = gc.poll(max_messages=64)
+                    if msgs:
+                        with lock:
+                            counts[gi] += len(msgs)
+                    with lock:
+                        if counts[gi] >= n_msgs:
+                            return
+            finally:
+                gc.close()
+
+        threads = [
+            _threading.Thread(target=member, args=(gi, mi), daemon=True)
+            for gi in range(n_groups) for mi in range(members)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with lock:
+                if all(v >= n_msgs for v in counts.values()):
+                    break
+            time.sleep(0.05)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exact = all(v == n_msgs for v in counts.values())
+        if not exact:
+            raise AssertionError(
+                f"group drain not count-exact: wanted {n_msgs}/group, "
+                f"got {counts} (duplicates or loss across the shared-"
+                f"offset path)"
+            )
+        total = sum(counts.values())
+        return {
+            "e2e_group_consume_msgs_per_sec": round(total / elapsed, 1),
+            "group_consume": {
+                "groups": n_groups, "members_per_group": members,
+                "partitions": partitions, "msgs_per_group": n_msgs,
+                "elapsed_s": round(elapsed, 3), "count_exact": exact,
+            },
+        }
+
+
 def _run_codec(batch: int = 256, payload_bytes: int = 100,
                iters: int = 400) -> dict:
     """Codec throughput on the produce-frame shape (the host-path codec
@@ -1343,6 +1439,9 @@ def main() -> None:
                                control_launches=ab_launches,
                                windows=2)
     codec_stats = _run_codec()
+    # ISSUE 7: multi-group drain through the consumer-group coordinator
+    # (count-exact per group, shared offsets, generation fencing live).
+    group_consume = _run_group_consume()
     e2e = _run_e2e()
 
     print(
@@ -1370,6 +1469,7 @@ def main() -> None:
                 "codec_mb_per_sec": codec_stats["codec_mb_per_sec"],
                 "codec_ab": codec_stats,
                 "readback": "verified",
+                **group_consume,
                 **e2e,
             }
         )

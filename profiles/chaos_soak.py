@@ -49,6 +49,15 @@ def main() -> int:
                          "(torn tail / bit flip / lost sealed segment) "
                          "instead of in-proc network faults; identical "
                          "JSON verdict schema")
+    ap.add_argument("--groups", type=int, default=0,
+                    help="run a consumer-group workload of N members "
+                         "and join the REBALANCE-STORM ops to the "
+                         "nemesis pool (member_pause / member_churn / "
+                         "stale_commit) on either backend; the checker "
+                         "adds the group invariants (no same-generation "
+                         "dual ownership, acked commits survive "
+                         "rebalance, stale commits fenced, bounded "
+                         "post-storm convergence)")
     ap.add_argument("--timeline", action="store_true",
                     help="attach the merged fault-vs-lifecycle timeline "
                          "(nemesis fault ops + every broker's flight-"
@@ -104,6 +113,7 @@ def main() -> int:
             ops_per_phase=args.ops_per_phase,
             schedule=schedule,
             backend=args.backend,
+            groups=args.groups,
             include_timeline=args.timeline,
             include_postmortems=args.postmortems,
             # Process boots (JAX import + XLA compiles per broker) put

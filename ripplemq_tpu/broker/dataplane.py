@@ -46,6 +46,7 @@ from ripplemq_tpu.parallel.mesh import make_mesh
 from ripplemq_tpu.storage.segment import (
     REC_APPEND,
     REC_OFFSETS,
+    REC_PIDSEQ,
     SegmentStore,
     scan_store,
 )
@@ -105,12 +106,20 @@ _OFFSET_HORIZON = (1 << 31) - (1 << 20)
 # DataPlane._read_cache).
 _CACHE_LAPPED = object()
 
+# Settled batches remembered per (pid, slot) for producer-sequence
+# dedup. The producer only ever replays sequences it never saw acked —
+# at most one batch deep per partition under the SDK's ack-gated
+# sequence advance — so a small window covers every legal replay;
+# anything older still refuses to re-append (acked as a duplicate with
+# base -1: present in the log, position no longer remembered).
+_PID_WINDOW = 8
+
 
 class _Pending:
-    __slots__ = ("payloads", "rows", "future", "rounds_left")
+    __slots__ = ("payloads", "rows", "future", "rounds_left", "pid", "seq")
 
     def __init__(self, payloads: list[bytes], future: Future,
-                 rounds_left: int, rows=None):
+                 rounds_left: int, rows=None, pid: int = 0, seq: int = -1):
         self.payloads = payloads
         # Appends carry their rows PRE-PACKED (pack_payload_rows on the
         # submitting thread); the drain only memcpys blocks and stamps
@@ -119,6 +128,11 @@ class _Pending:
         self.rows = rows
         self.future = future
         self.rounds_left = rounds_left
+        # Idempotent-producer identity: pid > 0 marks this batch as
+        # dedup-tracked — (pid, seq) survives requeues, so a retried
+        # round re-appends under the SAME identity.
+        self.pid = pid
+        self.seq = seq
 
 
 class _PendingOffsets(_Pending):
@@ -341,6 +355,21 @@ class DataPlane:
 
         self._appends: dict[int, list[_Pending]] = {}
         self._offsets: dict[int, list[_PendingOffsets]] = {}
+        # Idempotent-producer dedup state (guarded by self._lock).
+        # `_pid_tab`: (pid, slot) → recent SETTLED batches as
+        # (seq_start, seq_end, base), newest last, capped at _PID_WINDOW —
+        # a replayed sequence is acked as a duplicate with its original
+        # base instead of appending again. Entries are written into the
+        # replicated record stream (REC_PIDSEQ, beside each round's
+        # REC_APPEND) and rebuilt by boot/promotion replay, so a
+        # controller failover cannot re-open the dup window: every acked
+        # round is on every standby, and its pid entry rides the same
+        # records. `_pid_inflight`: (pid, slot, seq) → the Future of a
+        # batch whose round has not settled yet — a concurrent wire-dup
+        # of the same request attaches to the SAME future (one append,
+        # two identical acks).
+        self._pid_tab: dict[tuple[int, int], list[tuple[int, int, int]]] = {}
+        self._pid_inflight: dict[tuple[int, int, int], Future] = {}
         # Consecutive device-uncommitted rounds per slot (reset on any
         # committed round, and on set_leader — a fresh term is a fresh
         # chance). A long streak with a LIVE leader is the signature of
@@ -541,6 +570,7 @@ class DataPlane:
             leftovers += [p for q in self._offsets.values() for p in q]
             self._appends.clear()
             self._offsets.clear()
+            self._pid_inflight.clear()  # plane dead: nothing will settle
         for p in leftovers:
             if not p.future.done():
                 p.future.set_exception(
@@ -757,9 +787,21 @@ class DataPlane:
 
     # ------------------------------------------------------------- submits
 
-    def submit_append(self, slot: int, payloads: list[bytes]) -> Future:
+    def submit_append(self, slot: int, payloads: list[bytes],
+                      pid: int = 0, seq: int = -1) -> Future:
         """Queue payloads for partition `slot`; future resolves to the
-        first assigned absolute offset once the round commits."""
+        first assigned absolute offset once the round commits.
+
+        `pid`/`seq` (pid > 0) make the submit IDEMPOTENT: a batch whose
+        (pid, seq, len) matches a settled entry of the dedup table is
+        acked immediately with its original base offset — no second
+        append — and a batch identical to one still in flight attaches
+        to the in-flight round's future (the wire-dup window: both RPCs
+        see the same outcome). The table is replicated through the
+        settle path (REC_PIDSEQ records) and rebuilt on boot/promotion
+        replay, so the guarantee holds across controller failover. A
+        sequence ABOVE the table's end is accepted as new — dedup never
+        refuses fresh data, it only collapses replays."""
         fut: Future = Future()
         cfg = self.cfg
         if not 0 <= slot < cfg.partitions:
@@ -805,7 +847,18 @@ class DataPlane:
             return fut
         self._m_submits.inc()
         self._m_messages.inc(len(payloads))
+        pid, seq = int(pid), int(seq)
         with self._lock:
+            if pid > 0:
+                dup = self._pid_lookup_locked(pid, slot, seq, len(payloads))
+                if dup is not None:
+                    fut.set_result(dup)
+                    return fut
+                inflight = self._pid_inflight.get((pid, slot, seq))
+                if inflight is not None:
+                    # Same batch, round still in flight (wire dup /
+                    # concurrent retry): one append, shared outcome.
+                    return inflight
             if self._log_end[slot] >= _OFFSET_HORIZON:
                 fut.set_exception(
                     PartitionFullError(
@@ -816,10 +869,63 @@ class DataPlane:
                 )
                 return fut
             self._appends.setdefault(slot, []).append(
-                _Pending(list(payloads), fut, self.max_retry_rounds, rows)
+                _Pending(list(payloads), fut, self.max_retry_rounds, rows,
+                         pid=pid, seq=seq)
             )
+            if pid > 0:
+                # Settled batches are moved to the dedup table — and
+                # popped from here — by the settle thread under this
+                # same lock, so no dup can slip between the two. FAILED
+                # batches are popped at every terminal-failure site
+                # (_pid_drop_locked): the producer's retry must
+                # re-submit a real append, not attach to a dead future.
+                # (Not a done-callback: those run inline at
+                # set_exception, and several failure sites already hold
+                # this non-reentrant lock.)
+                self._pid_inflight[(pid, slot, seq)] = fut
         self._work.set()
         return fut
+
+    def _pid_lookup_locked(self, pid: int, slot: int, seq: int,
+                           n: int) -> Optional[int]:
+        """Dedup probe (caller holds self._lock): the batch's original
+        base offset if (pid, seq, n) replays a settled batch, -1 if it
+        falls fully below the settled window without an exact entry
+        (still a duplicate — ack it, position forgotten), None if the
+        batch is new. A batch extending PAST the settled end is new by
+        definition: refusing it could strand fresh data behind a stale
+        table after an at-least-once gap."""
+        entries = self._pid_tab.get((pid, slot))
+        if not entries:
+            return None
+        if seq + n > entries[-1][1]:
+            return None
+        for s0, s1, base in reversed(entries):
+            if s0 == seq and s1 == seq + n:
+                return base
+        return -1
+
+    def _pid_drop_locked(self, pend: "_Pending", slot: int) -> None:
+        """Drop one TERMINALLY-FAILED batch's in-flight dedup entry
+        (caller holds self._lock): nothing settled, so the producer's
+        retry must append for real. Guarded by identity — a fresh
+        submit may already occupy the key."""
+        if pend.pid <= 0:
+            return
+        key = (pend.pid, slot, pend.seq)
+        if self._pid_inflight.get(key) is pend.future:
+            self._pid_inflight.pop(key, None)
+
+    def _pid_drop(self, pend: "_Pending", slot: int) -> None:
+        if pend.pid > 0:
+            with self._lock:
+                self._pid_drop_locked(pend, slot)
+
+    def pid_table_size(self) -> int:
+        """Number of (pid, partition) keys in the producer dedup table
+        (admin.stats surface) — locked accessor, settle thread mutates."""
+        with self._lock:
+            return len(self._pid_tab)
 
     def submit_offsets(self, slot: int, updates: list[tuple[int, int]]) -> Future:
         """Queue consumer-offset commits [(consumer_slot, offset)]; the
@@ -1599,6 +1705,7 @@ class DataPlane:
                 # that only advances at resolve time). `end` here is
                 # exact — the slot is not busy and untouched this chain.
                 for pend in queue:
+                    self._pid_drop_locked(pend, slot)
                     if not pend.future.done():  # caller may cancel()
                         pend.future.set_exception(PartitionFullError(
                             f"partition {slot} reached the int32 "
@@ -2132,6 +2239,23 @@ class DataPlane:
             records.append(
                 (REC_APPEND, int(slot), int(rc["bases"][slot]), payload)
             )
+            # Producer-dedup entries ride the SAME record stream, right
+            # after their rows (a torn tail may drop the entry, never
+            # leave it pointing at unpersisted rows): standbys and boot
+            # replay rebuild the dedup table from these, closing the
+            # failover dup window.
+            ents = [
+                (pend.pid, pend.seq, n_taken,
+                 int(rc["bases"][slot]) + start)
+                for pend, start, n_taken in rc["appends"][slot]
+                if pend.pid > 0
+            ]
+            if ents:
+                records.append((
+                    REC_PIDSEQ, int(slot), len(ents),
+                    b"".join(struct.pack("<IqIq", p, s, k, b)
+                             for p, s, k, b in ents),
+                ))
         for slot, taken_off in rc["offsets"].items():
             if not committed[slot]:
                 continue
@@ -2189,8 +2313,8 @@ class DataPlane:
             self._last_flush = now
 
     def install(self, image: ReplicaState,
-                settled_gaps: Optional[dict[int, list[list[int]]]] = None
-                ) -> None:
+                settled_gaps: Optional[dict[int, list[list[int]]]] = None,
+                pid_table: Optional[dict] = None) -> None:
         """Install a recovered single-replica image (see recover_image).
         Re-derives the retention tables: the replayed ring holds at most
         the last `slots` rows per partition, so anything below
@@ -2224,6 +2348,16 @@ class DataPlane:
             self.trim = np.maximum(0, ends - self.cfg.slots)
             self._scan_index = None  # history may differ on this store
             self._offsets_shadow = np.asarray(image.offsets, np.int32).copy()
+            # Producer-dedup table recovered from the store's REC_PIDSEQ
+            # records (replay_records pid_tab_out): the failover half of
+            # idempotence — a retry straddling a promotion finds its
+            # settled entry here instead of re-appending. In-flight
+            # entries belong to the PREVIOUS plane's futures; drop them.
+            self._pid_tab = {
+                (int(p), int(s)): [tuple(int(x) for x in e) for e in v]
+                for (p, s), v in (pid_table or {}).items()
+            }
+            self._pid_inflight = {}
         with self._device_lock:
             self._state = self.fns.init_from(image)
         self.recorder.record(
@@ -2253,8 +2387,9 @@ class DataPlane:
         """Fail EVERY future of one dispatch (outcome unknown: dispatch
         or committed-fetch failure — nothing was requeued)."""
         exc = self._wrap_engine_exc(exc)
-        for taken in ctx["appends"].values():
+        for slot, taken in ctx["appends"].items():
             for pend, _, _ in taken:
+                self._pid_drop(pend, slot)
                 if not pend.future.done():
                     pend.future.set_exception(exc)
         for taken_off in ctx["offsets"].values():
@@ -2274,6 +2409,7 @@ class DataPlane:
                 if not committed[k, slot]:
                     continue
                 for pend, _, _ in taken:
+                    self._pid_drop(pend, slot)
                     if not pend.future.done():
                         pend.future.set_exception(exc)
             for slot, taken_off in rc["offsets"].items():
@@ -2368,6 +2504,7 @@ class DataPlane:
             "stalled_slots": self.stalled_slots(),
             "settled_gaps": {str(s): v for s, v in gaps.items()},
             "mirror_gap_slots": self.mirror_gap_slots(),
+            "pid_table_size": self.pid_table_size(),
             "settle": self.settle_stats(),
             "degraded_slots": self.degraded_slots(),
             "retry_budget": {
@@ -2399,6 +2536,36 @@ class DataPlane:
         order after the standby acks landed): release the COMMITTED
         work's futures."""
         if ack:
+            # Producer-dedup bookkeeping FIRST, in one lock hold and
+            # strictly before any future resolves: a wire-dup of an
+            # acked batch must find either the in-flight entry (pre-
+            # settle) or the table entry (post-settle) — never the gap
+            # between them (which would re-append an acked batch).
+            any_pid = any(
+                pend.pid > 0
+                for slot, taken in ctx["appends"].items()
+                if committed[slot]
+                for pend, _, _ in taken
+            )
+            if any_pid:
+                with self._lock:
+                    for slot, taken in ctx["appends"].items():
+                        if not committed[slot]:
+                            continue
+                        for pend, start, n in taken:
+                            if pend.pid <= 0:
+                                continue
+                            ents = self._pid_tab.setdefault(
+                                (pend.pid, slot), []
+                            )
+                            ents.append(
+                                (pend.seq, pend.seq + n,
+                                 int(base[slot]) + start)
+                            )
+                            del ents[:-_PID_WINDOW]
+                            self._pid_inflight.pop(
+                                (pend.pid, slot, pend.seq), None
+                            )
             new_entries = 0
             for slot, taken in ctx["appends"].items():
                 if committed[slot]:
@@ -2449,6 +2616,7 @@ class DataPlane:
             for pend, _, _ in taken:
                 pend.rounds_left -= 1
                 if full:
+                    self._pid_drop(pend, slot)
                     if not pend.future.done():  # caller may cancel()
                         pend.future.set_exception(
                             PartitionFullError(
@@ -2458,6 +2626,7 @@ class DataPlane:
                         )
                 elif pend.rounds_left <= 0:
                     self._m_retry_exhausted.inc()
+                    self._pid_drop(pend, slot)
                     if not pend.future.done():
                         pend.future.set_exception(
                             NotCommittedError(
@@ -2493,6 +2662,7 @@ class DataPlane:
                         q.pop(0)
                         if not q:
                             self._appends.pop(slot, None)
+                        self._pid_drop_locked(head, slot)
                         if not head.future.done():  # caller may cancel()
                             head.future.set_exception(
                                 NotCommittedError(
@@ -2528,22 +2698,27 @@ class DataPlane:
 
 def recover_image(cfg: EngineConfig, store_dir: str,
                   use_native: Optional[bool] = None,
-                  gaps_out: Optional[dict] = None) -> Optional[ReplicaState]:
+                  gaps_out: Optional[dict] = None,
+                  pid_tab_out: Optional[dict] = None
+                  ) -> Optional[ReplicaState]:
     """Replay a segment store directory into a single-replica state image,
     healing erasure-protected sealed segments first: a missing/corrupt
     sealed segment is rebuilt from any 3 of its 5 RS shards (the torn-
     tail contract of replay_records only covers the ACTIVE segment's
     tail). `gaps_out` receives the store's settled-gap map (see
-    replay_records) for DataPlane.install."""
+    replay_records) for DataPlane.install; `pid_tab_out` the recovered
+    producer-dedup table."""
     from ripplemq_tpu.storage.erasure import repair_store
 
     repair_store(store_dir)
     return replay_records(cfg, scan_store(store_dir, use_native),
-                          gaps_out=gaps_out)
+                          gaps_out=gaps_out, pid_tab_out=pid_tab_out)
 
 
 def replay_records(cfg: EngineConfig, records,
-                   gaps_out: Optional[dict] = None) -> Optional[ReplicaState]:
+                   gaps_out: Optional[dict] = None,
+                   pid_tab_out: Optional[dict] = None
+                   ) -> Optional[ReplicaState]:
     """Replay committed-round records into a single-replica state image.
 
     Returns None if there are no records. Only committed rounds are ever
@@ -2627,6 +2802,17 @@ def replay_records(cfg: EngineConfig, records,
             for cs, off in struct.iter_unpack("<II", payload):
                 if cs < C:
                     offsets[slot, cs] = off
+        elif rec_type == REC_PIDSEQ:
+            # Producer-dedup entries (idempotent producers): rebuild the
+            # (pid, slot) → recent-settled-batches table alongside the
+            # image. Scan order matters only within a key; a re-covered
+            # round's retry carries the same (pid, seq), so replayed
+            # duplicates collapse into equivalent entries.
+            if pid_tab_out is not None:
+                for pid, seq, n, b in struct.iter_unpack("<IqIq", payload):
+                    ents = pid_tab_out.setdefault((int(pid), int(slot)), [])
+                    ents.append((int(seq), int(seq) + int(n), int(b)))
+                    del ents[:-_PID_WINDOW]
         found = True
     if not found:
         return None

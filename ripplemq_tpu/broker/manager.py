@@ -36,6 +36,8 @@ from typing import Optional
 import numpy as np
 
 from ripplemq_tpu.broker.dataplane import DataPlane
+from ripplemq_tpu.groups.coordinator import GroupTable
+from ripplemq_tpu.groups.state import group_consumer_name
 from ripplemq_tpu.metadata.assigner import assign_partitions
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import (
@@ -59,6 +61,31 @@ class ConsumerTableFullError(Exception):
 OP_SET_TOPICS = "set_topics"
 OP_SET_LEADER = "set_leader"
 OP_REGISTER_CONSUMER = "register_consumer"
+# Idempotent producers: the metadata plane ISSUES producer ids (one
+# replicated counter — a pid must be unique across every broker and
+# every process lifetime, or two producers' sequence spaces collide in
+# the broker's dedup table).
+OP_REGISTER_PRODUCER = "register_producer"
+# Consumer-slot recycling: release frees a name→slot binding but parks
+# the slot as DIRTY (its device offset row still holds the old
+# consumer's positions); the controller resets the row through ordinary
+# offset rounds and proposes slot_clean, which returns the slot to the
+# allocatable pool. Split into two ops so allocation stays a pure
+# function of replicated state — a slot is never handed out while any
+# broker could still serve its stale offsets.
+OP_RELEASE_CONSUMER = "release_consumer"
+OP_CONSUMER_SLOT_CLEAN = "consumer_slot_clean"
+# Consumer groups (groups/): membership changes are replicated ops; the
+# assignment is recomputed deterministically inside the apply, so every
+# broker advertises the identical generation + partition map.
+OP_GROUP_JOIN = "group_join"
+OP_GROUP_LEAVE = "group_leave"
+# Reaping an EMPTY group after its retention window (metadata-leader
+# duty): the apply is conditional on the group still being empty, so a
+# racing re-join always wins. Only here does the group's shared
+# consumer slot release — an emptied-but-retained group keeps its
+# generation and offsets (see GroupTable.leave).
+OP_GROUP_DELETE = "group_delete"
 # Controller-failover ops (broker/replication.py): which broker drives
 # the device program (fenced by a monotone epoch) and which brokers hold
 # a full copy of its committed-round stream (the standby set).
@@ -99,6 +126,21 @@ class PartitionManager:
         self.topics: list[Topic] = []
         self.live: list[int] = list(config.broker_ids())
         self.consumers: dict[str, int] = {}
+        # Recycled-but-unreset consumer slots: released bindings whose
+        # device offset rows still hold the old consumer's positions.
+        # Not allocatable until the controller's reset rounds land and
+        # OP_CONSUMER_SLOT_CLEAN applies (see the op comments above).
+        self.dirty_consumer_slots: set[int] = set()
+        # Idempotent-producer registry: name → pid, plus the replicated
+        # pid counter (pid 0 is reserved = "no pid").
+        self.producers: dict[str, int] = {}
+        self.next_pid = 1
+        # Consumer groups: replicated membership/generation/assignment.
+        self.groups = GroupTable()
+        # Optional flight recorder (the owning BrokerServer's): group
+        # lifecycle events — join/leave/eviction/generation bumps — are
+        # control-plane transitions a rebalance timeline needs.
+        self.recorder = None
         self._applied_index = 0
         # Controller-failover state: the active controller, its fencing
         # epoch, and the standby set holding its committed-round stream.
@@ -141,6 +183,24 @@ class PartitionManager:
             )
         elif op == OP_REGISTER_CONSUMER:
             self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
+        elif op == OP_REGISTER_PRODUCER:
+            self._apply_register_producer(str(cmd["producer"]))
+        elif op == OP_RELEASE_CONSUMER:
+            self._apply_release_consumer(str(cmd["consumer"]))
+        elif op == OP_CONSUMER_SLOT_CLEAN:
+            self.dirty_consumer_slots.discard(int(cmd["slot"]))
+        elif op == OP_GROUP_JOIN:
+            self._apply_group_join(
+                str(cmd["group"]), str(cmd["member"]),
+                tuple(str(t) for t in cmd["topics"]),
+            )
+        elif op == OP_GROUP_LEAVE:
+            self._apply_group_leave(
+                str(cmd["group"]), str(cmd["member"]),
+                str(cmd.get("reason", "leave")),
+            )
+        elif op == OP_GROUP_DELETE:
+            self._apply_group_delete(str(cmd["group"]))
         elif op == OP_SET_CONTROLLER:
             self._apply_set_controller(
                 int(cmd["controller"]), int(cmd["epoch"]),
@@ -159,6 +219,10 @@ class PartitionManager:
                 "topics": topics_to_wire(self.topics),
                 "live": list(self.live),
                 "consumers": dict(self.consumers),
+                "dirty_consumer_slots": sorted(self.dirty_consumer_slots),
+                "producers": dict(self.producers),
+                "next_pid": self.next_pid,
+                "groups": self.groups.to_wire(),
                 "controller": self.controller_broker,
                 "controller_epoch": self.controller_epoch,
                 "standbys": list(self.standbys),
@@ -168,6 +232,16 @@ class PartitionManager:
         """hostraft restore_fn — install a metadata snapshot."""
         with self.lock:
             self.consumers = {str(k): int(v) for k, v in state["consumers"].items()}
+            # Pre-groups snapshots lack the newer sections: default them
+            # empty (same forward-compatibility rule as unknown ops).
+            self.dirty_consumer_slots = {
+                int(s) for s in state.get("dirty_consumer_slots", ())
+            }
+            self.producers = {
+                str(k): int(v) for k, v in state.get("producers", {}).items()
+            }
+            self.next_pid = int(state.get("next_pid", 1))
+            self.groups = GroupTable.from_wire(state.get("groups", {}))
             # Controller fields default to bootstrap values for snapshots
             # written before the failover machinery existed.
             self.controller_broker = int(
@@ -207,7 +281,7 @@ class PartitionManager:
         lowest free slot instead."""
         if name in self.consumers:
             return
-        used = set(self.consumers.values())
+        used = set(self.consumers.values()) | self.dirty_consumer_slots
         if slot in used:
             C = self.config.engine.max_consumers
             free = [s for s in range(C) if s not in used]
@@ -215,6 +289,64 @@ class PartitionManager:
                 return  # table full; registration request will time out
             slot = free[0]
         self.consumers[name] = slot
+
+    def _apply_register_producer(self, name: str) -> None:
+        """Issue one pid per producer name (idempotent — the client's
+        registration proposal may be retried/duplicated). The counter is
+        replicated state: a pid is unique across brokers AND process
+        lifetimes, which is what makes it a safe dedup-table key."""
+        if name in self.producers:
+            return
+        self.producers[name] = self.next_pid
+        self.next_pid += 1
+
+    def _apply_release_consumer(self, name: str) -> None:
+        """Free a consumer-name binding (group dissolution, member
+        eviction, or explicit release). The slot parks as DIRTY until
+        the controller's offset-reset rounds land (see the op comments):
+        reallocating it immediately would hand the new consumer the old
+        one's committed positions. The reference never releases at all —
+        its consumerOffsets map grows without bound
+        (PartitionStateMachine.java:27); this closes that as a recycle
+        instead of the PR-seed's refuse-only stance."""
+        slot = self.consumers.pop(name, None)
+        if slot is not None:
+            self.dirty_consumer_slots.add(slot)
+
+    def _apply_group_join(self, group: str, member: str,
+                          topics: tuple[str, ...]) -> None:
+        parts = {t.name: t.partitions for t in self.config.topics}
+        st, changed = self.groups.join(group, member, topics, parts)
+        if changed and self.recorder is not None:
+            self.recorder.record(
+                "group_join", group=group, member=member,
+                generation=st.generation, members=len(st.members),
+            )
+
+    def _apply_group_leave(self, group: str, member: str,
+                           reason: str) -> None:
+        parts = {t.name: t.partitions for t in self.config.topics}
+        st, changed, emptied = self.groups.leave(group, member, parts)
+        # An emptied group is RETAINED (generation + offsets intact):
+        # transient total-churn must not reset the group's identity.
+        # The metadata leader reaps it via OP_GROUP_DELETE only after
+        # group_retention_s of continuous emptiness.
+        if changed and self.recorder is not None:
+            self.recorder.record(
+                "group_leave", group=group, member=member, reason=reason,
+                generation=st.generation if st is not None else -1,
+                emptied=emptied,
+            )
+
+    def _apply_group_delete(self, group: str) -> None:
+        """Reap an empty group past retention: only NOW does the shared
+        offset slot release into the recycle path — the multi-tenant
+        workload's groups come and go without exhausting the fixed
+        [P, C] device table."""
+        if self.groups.delete(group):
+            self._apply_release_consumer(group_consumer_name(group))
+            if self.recorder is not None:
+                self.recorder.record("group_delete", group=group)
 
     def _apply_set_topics(self, topics: list[Topic], live: list[int],
                           *, full_surface: bool = False) -> None:
@@ -466,7 +598,7 @@ class PartitionManager:
         """Lowest unused consumer slot (proposals are idempotent: the
         first registration for a name wins, duplicates are no-ops)."""
         with self.lock:
-            used = set(self.consumers.values())
+            used = set(self.consumers.values()) | self.dirty_consumer_slots
             C = self.config.engine.max_consumers
             for s in range(C):
                 if s not in used:
@@ -474,6 +606,38 @@ class PartitionManager:
             raise ConsumerTableFullError(
                 f"consumer table full ({C} slots in use)"
             )
+
+    def producer_id(self, name: str) -> Optional[int]:
+        """Replicated pid for a registered producer name (None until the
+        registration op applies locally)."""
+        with self.lock:
+            return self.producers.get(name)
+
+    def group_state(self, group: str):
+        """A WIRE-COPY of one group's replicated state (GroupState), or
+        None. Copied so callers never hold a reference the next apply
+        mutates under them."""
+        from ripplemq_tpu.groups.state import GroupState
+
+        with self.lock:
+            st = self.groups.state(group)
+            return None if st is None else GroupState.from_wire(st.to_wire())
+
+    def groups_summary(self) -> dict:
+        with self.lock:
+            return self.groups.summary()
+
+    def empty_groups(self) -> list[str]:
+        """Groups retained with zero members (reap candidates once the
+        retention window lapses — BrokerServer._group_duty)."""
+        with self.lock:
+            return self.groups.empty_groups()
+
+    def dirty_slots(self) -> list[int]:
+        """Recycled consumer slots awaiting their offset reset (the
+        controller's slot-clean duty drains these)."""
+        with self.lock:
+            return sorted(self.dirty_consumer_slots)
 
     # ------------------------------------------- cluster-leader duty logic
 

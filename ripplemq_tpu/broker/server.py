@@ -47,11 +47,18 @@ from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner
 from ripplemq_tpu.broker.manager import (
     OP_BATCH,
+    OP_CONSUMER_SLOT_CLEAN,
+    OP_GROUP_DELETE,
+    OP_GROUP_JOIN,
+    OP_GROUP_LEAVE,
     OP_REGISTER_CONSUMER,
+    OP_REGISTER_PRODUCER,
     OP_SET_STANDBYS,
     ConsumerTableFullError,
     PartitionManager,
 )
+from ripplemq_tpu.groups.coordinator import GroupLiveness
+from ripplemq_tpu.groups.state import group_consumer_name
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import group_key, topics_to_wire
 from ripplemq_tpu.utils.logs import get_logger
@@ -270,6 +277,34 @@ class BrokerServer:
         # --- control plane (the dataplane attaches after, since the
         # restored metadata decides who the controller is) ---
         self.manager = PartitionManager(broker_id, config, None)
+        # Group lifecycle events (join/leave/eviction/generation bumps)
+        # land in THIS broker's flight recorder — the rebalance timeline
+        # chaos verdicts merge.
+        self.manager.recorder = self.recorder
+        # Volatile heartbeat ledger (consulted only while this broker is
+        # the metadata leader — see _group_duty), plus the empty-group
+        # retention stamps (group → first seen empty on THIS leader; a
+        # leader change restarts every window, the same volatile-grace
+        # rule as member sessions).
+        self._group_liveness = GroupLiveness()
+        self._group_empty_since: dict[str, float] = {}
+        # Broker-stamped idempotence for pid-LESS produces: the leader
+        # stamps each forwarded batch with its own metadata-issued pid +
+        # a per-slot sequence, so a duplicated leader→controller
+        # engine.append RPC (the wire's at-least-once window) collapses
+        # in the controller's dedup table even for clients that never
+        # opted into idempotence. Registered via the duty loop; until
+        # the pid applies, produces flow unstamped (at-least-once, the
+        # pre-PR behavior).
+        import uuid as _uuid
+
+        self._broker_pid: Optional[int] = None
+        self._broker_pid_name = (
+            f"_broker/{broker_id}/{_uuid.uuid4().hex[:12]}"
+        )
+        self._broker_pid_proposed = 0.0
+        self._stamp_lock = threading.Lock()
+        self._stamp_seqs: dict[int, int] = {}
         persist_fn = None
         if data_dir is not None:
             import os
@@ -415,9 +450,13 @@ class BrokerServer:
                 # booted plane keeps refusing to serve them
                 # (replay_records gaps_out; ISSUE 4 residual window 2).
                 gaps = {}
+                # The producer-dedup table rides the same records
+                # (REC_PIDSEQ): rebuilding it here is what keeps a
+                # producer retry straddling this promotion exactly-once.
+                pid_tab = {}
                 image = replay_records(
                     self.config.engine, self._round_store.scan(),
-                    gaps_out=gaps,
+                    gaps_out=gaps, pid_tab_out=pid_tab,
                 )
             dp = DataPlane(
                 self.config.engine, mode=self._engine_mode,
@@ -433,7 +472,7 @@ class BrokerServer:
                 recorder=self.recorder,
             )
             if image is not None:
-                dp.install(image, settled_gaps=gaps)
+                dp.install(image, settled_gaps=gaps, pid_table=pid_tab)
             if self._round_store is not None:
                 self._wire_replicator(dp)
             self._owns_dataplane = True
@@ -615,6 +654,10 @@ class BrokerServer:
                 return self._handle_consume(req)
             if t == "offset.commit":
                 return self._handle_offset_commit(req)
+            if t == "producer.register":
+                return self._handle_producer_register(req)
+            if t.startswith("group."):
+                return self._handle_group(t, req)
             if t == "repl.rounds":
                 return self._handle_repl_rounds(req)
             if t == "admin.stats":
@@ -723,6 +766,13 @@ class BrokerServer:
             },
             "topics": topics,
             "live": list(self.manager.live),
+            # Consumer groups: per-group generation + membership (the
+            # coordinator's replicated view — identical on every broker).
+            "groups": self.manager.groups_summary(),
+            # Idempotent-producer registry size (issued pids, including
+            # broker-stamping pids) and recycled slots awaiting reset.
+            "producer_ids": len(self.manager.producers),
+            "dirty_consumer_slots": self.manager.dirty_slots(),
             "duty_errors": list(self.duty_errors),
             "erasure_errors": list(
                 getattr(self._round_store, "erasure_errors", [])
@@ -765,6 +815,11 @@ class BrokerServer:
                 # `unavailable` instead of hanging; the flag makes that
                 # state operator-visible before the first refusal.
                 "degraded_slots": dp.degraded_slots(),
+                # Producer-dedup table occupancy ((pid, partition) keys):
+                # the idempotence plane's memory footprint, and a rough
+                # count of distinct producer streams the broker has
+                # settled.
+                "pid_table_size": dp.pid_table_size(),
             }
             engine["degraded"] = bool(engine["degraded_slots"])
             slots = req.get("slots")
@@ -1210,15 +1265,30 @@ class BrokerServer:
         return slot, None
 
     def _handle_produce(self, req: dict) -> dict:
-        """Produce semantics are at-least-once: a batch larger than
-        max_batch is split into pipelined rounds, and some rounds can fail
-        while others commit (a failed middle round leaves a gap). ALL
-        pipelined rounds are drained before responding; on any failure the
-        error carries the total number of messages that did commit in
-        `committed`, so a client that retries the whole batch knows it is
-        duplicating that many (the reference has the same window one
-        message at a time — its closure can fail after the Raft entry
-        committed, MessageAppendRequestProcessor.java:36-67)."""
+        """Produce semantics: at-least-once by default, EXACTLY-ONCE for
+        idempotent producers. A batch larger than max_batch is split into
+        pipelined rounds, and some rounds can fail while others commit (a
+        failed middle round leaves a gap). ALL pipelined rounds are
+        drained before responding; on any failure the error carries the
+        total number of messages that did commit in `committed`, so a
+        client that retries the whole batch knows it is duplicating that
+        many (the reference has the same window one message at a time —
+        its closure can fail after the Raft entry committed,
+        MessageAppendRequestProcessor.java:36-67).
+
+        Idempotence: a request carrying (`pid`, `seq`) — the client SDK's
+        registered producer id + its ack-gated per-partition sequence —
+        dedupes at the controller's append path (DataPlane.submit_append):
+        a replayed sequence is acked with its original base offset, never
+        appended twice, including across controller failover (the dedup
+        table replicates through the settle path). A pid-less request is
+        STAMPED with this broker's own pid + per-slot sequence before
+        forwarding, which collapses duplicated leader→controller RPC
+        frames the same way — so clean single-attempt acks are
+        exactly-once for every client, opted-in or not. Chunk k of a
+        split batch takes `seq + k*max_batch`-adjacent sequence ranges,
+        reproducibly (max_batch is config-static), so a full-batch replay
+        re-chunks identically and every chunk dedupes."""
         key = group_key(req["topic"], req["partition"])
         slot, refusal = self._check_partition(key)
         if refusal:
@@ -1226,9 +1296,19 @@ class BrokerServer:
         messages = req["messages"]
         if not isinstance(messages, list) or not messages:
             return {"ok": False, "error": "bad_request: empty messages"}
+        if req.get("pid") is not None:
+            pid, seq = int(req["pid"]), int(req.get("seq", -1))
+        else:
+            pid, seq = self._stamp_pid_seq(slot, len(messages))
         B = self.config.engine.max_batch
         chunks = [messages[i : i + B] for i in range(0, len(messages), B)]
-        futs = [self._engine_append(slot, chunk) for chunk in chunks]
+        futs = [
+            self._engine_append(
+                slot, chunk, pid,
+                seq + i * B if pid > 0 else -1,
+            )
+            for i, chunk in enumerate(chunks)
+        ]
         base0 = None
         committed = 0
         first_err: Optional[Exception] = None
@@ -1308,11 +1388,182 @@ class BrokerServer:
         refusal = self._quorum_refusal(slot)
         if refusal:
             return refusal
+        fenced = req.get("group") is not None
+        if fenced:
+            refusal = self._fence_group_commit(req, key)
+            if refusal:
+                return refusal
         cslot = self._resolve_consumer(req["consumer"])
         if cslot is None:
             return {"ok": False, "error": "consumer_registration_failed"}
         self._engine_offsets(slot, [(cslot, int(req["offset"]))])
+        if fenced:
+            # Re-check AFTER the offset round: the fence read (metadata
+            # raft) and the offset write (engine round) are separate
+            # replication planes, so a rebalance can apply between them.
+            # If it did, answer FENCED even though the write landed —
+            # the member then delivers nothing, which is exactly the
+            # documented commit-before-deliver at-most-once outcome (a
+            # crash between commit and delivery behaves identically);
+            # answering ok would let a just-deposed member deliver rows
+            # the partition's new owner may also deliver. The landed
+            # offset itself is monotone and harmless. Residual window:
+            # a rebalance applying after this re-check but before the
+            # new owner's first read can still skip-or-duplicate at the
+            # handover boundary — closing it fully needs the generation
+            # carried INSIDE the offset round (ROADMAP, group plane).
+            refusal = self._fence_group_commit(req, key)
+            if refusal:
+                return refusal
         return {"ok": True}
+
+    def _fence_group_commit(self, req: dict, key) -> Optional[dict]:
+        """Generation fencing: a group commit must come from a CURRENT
+        member of the CURRENT generation that OWNS the partition. A
+        stale-generation member — deposed by a rebalance it has not
+        observed yet — gets a typed `fenced_generation` refusal, never a
+        silent overwrite of the new owner's progress (the group's
+        offsets are shared state; this fence is what makes them safe
+        under churn). The check reads replicated state, so ANY broker
+        serving the commit fences identically."""
+        group = str(req["group"])
+        member = str(req.get("member", ""))
+        gen = int(req.get("generation", -1))
+        st = self.manager.group_state(group)
+        why = None
+        if st is None:
+            why = f"group {group!r} does not exist"
+        elif member not in st.members:
+            why = f"member {member!r} is not in generation {st.generation}"
+        elif gen != st.generation:
+            why = f"generation {gen} != current {st.generation}"
+        elif key not in st.assignment.get(member, ()):
+            why = (f"partition {key} is not assigned to {member!r} in "
+                   f"generation {st.generation}")
+        if why is None:
+            return None
+        self.recorder.record(
+            "fence", group=group, member=member, generation=gen,
+            topic=key[0], partition=key[1],
+        )
+        return {"ok": False, "error": f"fenced_generation: {why}"}
+
+    # -- producers / groups ------------------------------------------------
+
+    def _handle_producer_register(self, req: dict) -> dict:
+        """Issue (or look up) a producer id: proposes the replicated
+        registration and waits for the local apply — the same shape as
+        consumer registration, minus the slot table (pids are a counter,
+        not a fixed device dimension)."""
+        name = str(req["name"])
+        pid = self.manager.producer_id(name)
+        if pid is not None:
+            return {"ok": True, "pid": pid}
+        if not self.propose_cmd(
+            {"op": OP_REGISTER_PRODUCER, "producer": name}
+        ):
+            return {"ok": False, "error": "not_committed: producer "
+                                          "registration not proposed"}
+        deadline = time.monotonic() + self.config.rpc_timeout_s
+        while time.monotonic() < deadline:
+            pid = self.manager.producer_id(name)
+            if pid is not None:
+                return {"ok": True, "pid": pid}
+            time.sleep(0.01)
+        return {"ok": False, "error": "not_committed: producer "
+                                      "registration timed out"}
+
+    def _handle_group(self, t: str, req: dict) -> dict:
+        group = str(req["group"])
+        if t == "group.describe":
+            st = self.manager.group_state(group)
+            if st is None:
+                return {"ok": True, "exists": False, "generation": -1,
+                        "members": [], "assignment": {}}
+            return {
+                "ok": True, "exists": True, "generation": st.generation,
+                "members": sorted(st.members),
+                "assignment": {
+                    m: [[tp, p] for tp, p in keys]
+                    for m, keys in st.assignment.items()
+                },
+            }
+        member = str(req["member"])
+        if t == "group.join":
+            topics = [str(x) for x in req.get("topics", [])]
+            known = {tp.name for tp in self.config.topics}
+            bad = [x for x in topics if x not in known]
+            if not topics or bad:
+                return {"ok": False,
+                        "error": f"bad_request: unknown topics {bad}"}
+            st = self.manager.group_state(group)
+            if (st is None or st.members.get(member)
+                    != tuple(sorted(set(topics)))):
+                if not self.propose_cmd({
+                    "op": OP_GROUP_JOIN, "group": group, "member": member,
+                    "topics": topics,
+                }):
+                    return {"ok": False,
+                            "error": "not_committed: join not proposed"}
+            deadline = time.monotonic() + self.config.rpc_timeout_s
+            while time.monotonic() < deadline:
+                st = self.manager.group_state(group)
+                if st is not None and member in st.members:
+                    return self._member_view(st, member)
+                time.sleep(0.01)
+            return {"ok": False, "error": "not_committed: join timed out"}
+        if t == "group.leave":
+            st = self.manager.group_state(group)
+            if st is None or member not in st.members:
+                return {"ok": True}  # idempotent
+            if not self.propose_cmd({
+                "op": OP_GROUP_LEAVE, "group": group, "member": member,
+                "reason": str(req.get("reason", "leave")),
+            }):
+                return {"ok": False,
+                        "error": "not_committed: leave not proposed"}
+            deadline = time.monotonic() + self.config.rpc_timeout_s
+            while time.monotonic() < deadline:
+                st = self.manager.group_state(group)
+                if st is None or member not in st.members:
+                    return {"ok": True}
+                time.sleep(0.01)
+            return {"ok": False, "error": "not_committed: leave timed out"}
+        if t == "group.heartbeat":
+            # Liveness is the METADATA LEADER's ledger (evictions are its
+            # duty): forward a follower-received beat, one hop.
+            node = self.runner.node
+            if node.role != LEADER:
+                hint = node.leader_hint
+                if hint is None or hint == self.broker_id:
+                    return {"ok": False, "error": "not_leader",
+                            "leader": hint}
+                try:
+                    return self._raft_client.call(
+                        self._addr_of(hint), dict(req),
+                        timeout=min(2.0, self.config.rpc_timeout_s),
+                    )
+                except RpcError as e:
+                    return {"ok": False, "error": f"not_leader: {e}"}
+            st = self.manager.group_state(group)
+            if st is None or member not in st.members:
+                return {"ok": False,
+                        "error": f"unknown_member: {member!r} not in "
+                                 f"{group!r} (evicted or never joined); "
+                                 f"rejoin required"}
+            self._group_liveness.beat(group, member)
+            return self._member_view(st, member)
+        return {"ok": False, "error": f"unknown request type {t!r}"}
+
+    def _member_view(self, st, member: str) -> dict:
+        return {
+            "ok": True,
+            "generation": st.generation,
+            "members": sorted(st.members),
+            "assignment": [
+                [tp, p] for tp, p in st.assignment.get(member, ())
+            ],
+        }
 
     def _resolve_consumer(self, consumer: str) -> Optional[int]:
         """Consumer name → replicated slot, registering on first sight.
@@ -1384,15 +1635,54 @@ class BrokerServer:
             raise RpcError(f"engine call failed: {err}")
         return resp
 
-    def _engine_append(self, slot: int, messages: list[bytes]) -> Callable[[], int]:
+    def _stamp_pid_seq(self, slot: int, n: int) -> tuple[int, int]:
+        """Broker-side idempotence stamp for a pid-less produce: this
+        broker's own pid (once its registration applied — see the duty)
+        plus `n` sequence numbers from the per-slot counter. (0, -1)
+        while the pid is still registering: the produce flows unstamped
+        rather than stall behind the metadata raft."""
+        pid = self._broker_pid
+        if pid is None:
+            pid = self.manager.producer_id(self._broker_pid_name)
+            if pid is None:
+                return 0, -1
+            self._broker_pid = pid
+        with self._stamp_lock:
+            seq = self._stamp_seqs.get(slot, 0)
+            self._stamp_seqs[slot] = seq + n
+        return pid, seq
+
+    def _producer_pid_duty(self) -> None:
+        """Register this broker's stamping pid with the metadata plane
+        (once; re-proposed at 1 s spacing until the apply lands). The
+        name embeds a per-boot nonce, so a restarted broker gets a FRESH
+        pid — its in-memory sequence counters restart at zero, and
+        reusing the old pid would collide with the table the cluster
+        still holds for it."""
+        if self._broker_pid is not None:
+            return
+        if self.manager.producer_id(self._broker_pid_name) is not None:
+            return  # applied; the next stamp picks it up
+        now = time.monotonic()
+        if now - self._broker_pid_proposed < 1.0:
+            return
+        self._broker_pid_proposed = now
+        self.propose_cmd(
+            {"op": OP_REGISTER_PRODUCER, "producer": self._broker_pid_name},
+            retries=1,
+        )
+
+    def _engine_append(self, slot: int, messages: list[bytes],
+                       pid: int = 0, seq: int = -1) -> Callable[[], int]:
         """Returns a waiter so multi-chunk produces pipeline their rounds
         (both paths submit WITHOUT blocking: local futures, or pipelined
         RPC frames when a TcpClient with call_async is underneath)."""
         dp = self._local_engine()
         if dp is not None:
-            fut = dp.submit_append(slot, messages)
+            fut = dp.submit_append(slot, messages, pid=pid, seq=seq)
             return lambda: int(fut.result(timeout=self.config.rpc_timeout_s))
-        req = {"type": "engine.append", "slot": slot, "messages": messages}
+        req = {"type": "engine.append", "slot": slot, "messages": messages,
+               "pid": pid, "seq": seq}
         call_async = getattr(self.client, "call_async", None)
         if call_async is None:  # in-proc transport: synchronous by design
             resp = self._engine_call(req)
@@ -1514,7 +1804,10 @@ class BrokerServer:
                     "controller_addr": self._controller_addr()}
         if t == "engine.append":
             fut = dp.submit_append(
-                int(req["slot"]), list(req["messages"])
+                int(req["slot"]), list(req["messages"]),
+                pid=int(req.get("pid", 0) or 0),
+                seq=int(req.get("seq", -1) if req.get("seq") is not None
+                        else -1),
             )
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
@@ -1606,10 +1899,13 @@ class BrokerServer:
         while not self._stop.wait(self._duty_interval_s):
             try:
                 self._metadata_leader_duty()
+                self._producer_pid_duty()
+                self._group_duty()
                 self._abdicate_duty()
                 self._fence_duty()
                 self._takeover_duty()
                 self._controller_duty()
+                self._slot_clean_duty()
                 self._standby_duty()
                 self._shard_duty()
             except Exception as e:  # duties must never kill the loop
@@ -1638,6 +1934,97 @@ class BrokerServer:
         ctrl_cmd = self.manager.plan_controller(alive)
         if ctrl_cmd is not None:
             self.runner.propose(ctrl_cmd)
+
+    def _group_duty(self) -> None:
+        """Metadata leader: evict group members whose heartbeat session
+        lapsed (liveness-flap → rebalance). Eviction is an ordinary
+        OP_GROUP_LEAVE — the apply bumps the generation and reassigns,
+        and the member's next heartbeat/commit sees `unknown_member` /
+        `fenced_generation` and rejoins. A fresh leader grants every
+        member a full grace window (volatile ledger; see GroupLiveness)."""
+        node = self.runner.node
+        if node.role != LEADER:
+            # Both ledgers are only meaningful while CONTINUOUSLY
+            # leading: stamps recorded during a previous tenure are
+            # stale the moment leadership is lost (members beat the new
+            # leader; emptiness may have been interrupted). Clearing
+            # them here is what makes re-election grant a full grace
+            # window — otherwise a re-elected leader's first tick could
+            # mass-evict healthy members (last beats predate the
+            # interregnum) or reap a group after seconds of REAL
+            # emptiness (an empty-since stamp from the previous
+            # tenure).
+            self._group_empty_since.clear()
+            self._group_liveness.clear()
+            return
+        with self.manager.lock:
+            table = self.manager.groups
+            evict = self._group_liveness.plan_evictions(
+                table, self.config.group_session_timeout_s
+            )
+        for group, member in evict:
+            log.info("broker %d: evicting group member %s/%s "
+                     "(session lapsed)", self.broker_id, group, member)
+            self._group_liveness.forget(group, member)
+            self.propose_cmd(
+                {"op": OP_GROUP_LEAVE, "group": group, "member": member,
+                 "reason": "evicted"},
+                retries=1,
+            )
+        # Empty-group retention: a group with zero members keeps its
+        # generation and shared offsets (transient total-churn must not
+        # reset the group's identity — see GroupTable.leave); only
+        # after group_retention_s of CONTINUOUS emptiness on this
+        # leader is it reaped, releasing the offset slot for recycling.
+        # The apply re-checks emptiness, so a rejoin racing the reap
+        # proposal wins.
+        now = time.monotonic()
+        empty = set(self.manager.empty_groups())
+        for g in list(self._group_empty_since):
+            if g not in empty:
+                del self._group_empty_since[g]
+        for g in empty:
+            t0 = self._group_empty_since.setdefault(g, now)
+            if now - t0 > self.config.group_retention_s:
+                self._group_empty_since.pop(g, None)
+                self.propose_cmd(
+                    {"op": OP_GROUP_DELETE, "group": g}, retries=1
+                )
+
+    def _slot_clean_duty(self) -> None:
+        """Controller: drain the recycled-consumer-slot reset queue. A
+        released slot's device offset row still holds the OLD consumer's
+        positions; this duty zeroes it through ordinary replicated
+        offset rounds (partition by partition, only where the shadow is
+        nonzero) and then proposes OP_CONSUMER_SLOT_CLEAN, returning the
+        slot to the allocatable pool. Work is bounded per tick (one
+        slot), and a partition that cannot commit right now (quorum
+        lost) just retries next tick — the slot stays dirty, never
+        allocatable, so correctness is never racing the reset."""
+        dp = self._local_engine()
+        if dp is None:
+            return
+        dirty = self.manager.dirty_slots()
+        if not dirty:
+            return
+        cslot = dirty[0]
+        futs = []
+        for slot in range(dp.cfg.partitions):
+            if dp.read_offset(slot, cslot) == 0:
+                continue
+            if dp.quorum_lost(slot):
+                return  # retry the whole slot next tick
+            futs.append(dp.submit_offsets(slot, [(cslot, 0)]))
+        try:
+            for fut in futs:
+                fut.result(timeout=self.config.rpc_timeout_s)
+        except Exception as e:
+            log.info("broker %d: slot-clean reset for cslot %d deferred: "
+                     "%s: %s", self.broker_id, cslot, type(e).__name__, e)
+            return  # offsets stay dirty; retried next tick
+        self.propose_cmd(
+            {"op": OP_CONSUMER_SLOT_CLEAN, "slot": cslot}, retries=1
+        )
 
     def _abdicate_duty(self) -> None:
         """Controller whose data plane broke PERMANENTLY (lockstep mesh

@@ -38,7 +38,11 @@ The pieces (each importable on its own):
 
 from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
 from ripplemq_tpu.chaos.harness import run_chaos, run_kill_all_drill
-from ripplemq_tpu.chaos.history import History, check_history
+from ripplemq_tpu.chaos.history import (
+    History,
+    check_group_history,
+    check_history,
+)
 from ripplemq_tpu.chaos.nemesis import Nemesis, make_schedule
 from ripplemq_tpu.chaos.proc_cluster import ProcCluster
 
@@ -50,6 +54,7 @@ __all__ = [
     "run_kill_all_drill",
     "History",
     "check_history",
+    "check_group_history",
     "Nemesis",
     "make_schedule",
 ]

@@ -25,6 +25,7 @@ from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
 from ripplemq_tpu.chaos.history import (
     History,
     TrackingRetryPolicy,
+    check_group_history,
     check_history,
 )
 from ripplemq_tpu.chaos.nemesis import Nemesis, trace_json
@@ -239,6 +240,7 @@ def run_chaos(
     backend: str = "inproc",
     include_postmortems: bool = False,
     include_timeline: bool = False,
+    groups: int = 0,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -248,6 +250,14 @@ def run_chaos(
     fake transport — network faults, fastest) or "proc" (real broker
     subprocesses over TCP — SIGKILL + disk-fault schedules against the
     deployment shape; chaos.proc_cluster). Verdict schema is identical.
+
+    `groups > 0` adds a consumer-group workload of that many members
+    (one group, drained through the real GroupConsumer SDK on either
+    backend) and joins the REBALANCE-STORM ops to the nemesis pool
+    (member_pause / member_churn / stale_commit — chaos/groups.py); the
+    checker then also asserts the group invariants
+    (check_group_history) and the verdict carries a `group` section
+    with post-heal convergence to one stable generation.
 
     A VIOLATING verdict always carries `postmortems` (one
     admin.postmortem bundle per reachable broker — the diagnosis the
@@ -276,6 +286,9 @@ def run_chaos(
             free_ports(n_brokers),
             topics=(Topic(topic, partitions, replication),),
             linearizable_reads=True,  # same checker rationale as below
+            # Short member sessions so a paused member's eviction (and
+            # the rebalance it forces) lands INSIDE a chaos phase.
+            group_session_timeout_s=0.8,
         )
         cluster = ProcCluster(config=config, data_dir=data_dir)
     else:
@@ -291,6 +304,7 @@ def run_chaos(
             # contract the deployment opted out of. The chaos cluster
             # opts IN, so every surviving violation is a real bug.
             linearizable_reads=True,
+            group_session_timeout_s=0.8,  # see the proc branch above
         )
         cluster = InProcCluster(config, data_dir=data_dir)
     history = History()
@@ -301,7 +315,7 @@ def run_chaos(
         cluster.wait_for_leaders()
         nemesis = Nemesis(cluster, seed, phases,
                           ops_per_phase=ops_per_phase, schedule=schedule,
-                          backend=backend)
+                          backend=backend, group_members=groups)
         # Wait for one replication standby before the first crash:
         # settled appends are then provably on a promotable peer.
         deadline = time.time() + (120 if backend == "proc" else 20)
@@ -311,6 +325,15 @@ def run_chaos(
             time.sleep(0.05)
         workload = _Workload(cluster, seed, history, topic, partitions)
         workload.start()
+        group_workload = None
+        if groups > 0:
+            from ripplemq_tpu.chaos.groups import GroupWorkload
+
+            group_workload = GroupWorkload(
+                cluster, seed, history, topic, partitions, members=groups,
+            )
+            nemesis.group_ops = group_workload
+            group_workload.start()
         convergence = []
         try:
             # Clean warmup: consumer registration and the first
@@ -329,23 +352,38 @@ def run_chaos(
             # Clean tail: post-heal reads drain through the workload
             # consumer too (its offsets advanced through the faults).
             time.sleep(0.3)
+            # Group convergence is part of the verdict: after the last
+            # heal, the members must settle on ONE stable generation
+            # covering every partition (the rebalance-storm bound).
+            group_verdict = None
+            if group_workload is not None:
+                group_verdict = group_workload.wait_converged(
+                    timeout=converge_timeout_s
+                )
+                group_verdict["generations_seen"] = sorted(
+                    group_workload.generations_seen
+                )
         finally:
             workload.stop()
+            if group_workload is not None:
+                group_workload.stop()
         final_logs = {
             (topic, pid): _drain_partition(cluster, topic, pid,
                                            tag=f"{seed}-{pid}")
             for pid in range(partitions)
         }
-        # Suspend the clean-ack exactly-once check only when a
-        # duplication was actually DELIVERED (handler ran twice) — a
-        # scheduled dup whose charge was eaten by a concurrent
-        # block/drop never duplicated anything, and the invariant
-        # must stay armed for that run. (The proc backend has no
-        # injection network and so never duplicates.)
-        net = getattr(cluster, "net", None)
-        dup_faults = net is not None and net.dups_applied > 0
-        violations = check_history(history.ops(), final_logs,
-                                   allow_wire_dups=dup_faults)
+        # Clean-ack exactly-once is UNCONDITIONAL: wire-dup schedules
+        # are collapsed by the idempotent-producer dedup plane (client
+        # pids + broker stamping on the forwarded hop) — the PR 2
+        # suspension branch is gone, on purpose.
+        violations = check_history(history.ops(), final_logs)
+        if group_workload is not None:
+            violations += check_group_history(history.ops())
+            if not group_verdict.get("converged"):
+                violations.append(
+                    f"group convergence failed within "
+                    f"{converge_timeout_s}s: {group_verdict}"
+                )
         ops = history.ops()
         # Telemetry collection — while the cluster is still up. Every
         # VIOLATING verdict carries the full diagnosis (per-broker
@@ -362,7 +400,16 @@ def run_chaos(
             )
         if violations or include_postmortems:
             verdict["postmortems"] = postmortems
+        if group_workload is not None:
+            verdict["group"] = {"members": groups, **group_verdict}
+        net = getattr(cluster, "net", None)
         verdict.update(
+            # Forensics: how many scheduled wire duplications actually
+            # DELIVERED (handler ran twice). Under the unconditional
+            # exactly-once checker this is the proof a dup schedule
+            # really exercised the dedup plane rather than having its
+            # charges eaten by concurrent blocks/drops.
+            wire_dups_applied=(net.dups_applied if net is not None else 0),
             trace=nemesis.trace,
             # Injection forensics (what the disk ops actually hit) —
             # informational, NOT part of the byte-reproducible trace.

@@ -11,12 +11,16 @@ and reports INVARIANT VIOLATIONS — the Jepsen/Elle method
    loses one is a real safety bug, not bad luck).
 2. **No phantoms** — nothing in a final log or a consume batch that no
    producer ever sent.
-3. **At-most-once beyond the documented contract** — a CLEANLY acked
-   produce (first attempt, no client retry) appears exactly once;
-   retried/unknown-outcome produces may legitimately duplicate (the
-   produce path is at-least-once under retry — broker/server.py
-   `_handle_produce` docstring) so only clean acks are held to
-   exactly-once.
+3. **Clean-ack exactly-once, UNCONDITIONALLY** — a cleanly acked
+   produce (first attempt, no client retry) appears exactly once, under
+   EVERY schedule including wire duplication. The PR 2 suspension under
+   `dup_next` schedules is gone: idempotent producer ids + the broker's
+   replicated (pid, seq) dedup table (client/producer.py,
+   broker/dataplane.py) collapse duplicated RPCs — on the client hop,
+   the forwarded leader→controller hop (broker-stamped), and across
+   controller failover. Retried/unknown-outcome produces may still
+   legitimately duplicate (an abandoned batch burns its sequence
+   range), so only clean acks are held to exactly-once.
 4. **Log order consistency** — each consumer's delivered sequence per
    partition is a subsequence of the final log (no reorder, no
    divergent replica serving a different history), and two reads at the
@@ -92,17 +96,15 @@ def _subsequence_gap(needle: list[str], hay: list[str]) -> Optional[str]:
 
 def check_history(ops: list[dict],
                   final_logs: dict[tuple[str, int], list[str]],
-                  allow_wire_dups: bool = False,
                   loss_grace: Optional[list[tuple[float, float]]] = None,
                   ) -> list[str]:
     """Return the list of invariant violations (empty = safe).
 
     `ops`: History.ops(). `final_logs`: {(topic, partition): [payload,
     ...]} — every partition's full committed log drained AFTER heal.
-    `allow_wire_dups`: the fault schedule contained RPC duplication
-    (`dup_next`) — a duplicated produce/forward RPC legitimately
-    appends twice (the wire is at-least-once, there is no idempotent
-    producer id), so the clean-ack exactly-once check is suspended.
+    Clean-ack exactly-once is asserted UNCONDITIONALLY — including under
+    wire-duplication schedules; idempotent producer dedup is the
+    machinery that must make it hold (module docstring, invariant 3).
 
     `loss_grace`: wall-clock [(t0, t1)] windows inside which an acked
     produce is EXEMPT from the no-loss check — the `flush_async`
@@ -141,8 +143,7 @@ def check_history(ops: list[dict],
                     f"(attempts={op.get('attempts', 1)}) but absent from "
                     f"the final log"
                 )
-        if (op["status"] == "ok" and op.get("attempts", 1) == 1 and n > 1
-                and not allow_wire_dups):
+        if op["status"] == "ok" and op.get("attempts", 1) == 1 and n > 1:
             violations.append(
                 f"duplicate beyond contract: clean first-attempt ack of "
                 f"{payload!r} appears {n}x in {part}"
@@ -230,4 +231,67 @@ def check_history(ops: list[dict],
                     f"{committed[key]}"
                 )
             committed[key] = max(committed.get(key, 0), off)
+    return violations
+
+
+def check_group_history(ops: list[dict]) -> list[str]:
+    """Consumer-group invariants over a GroupWorkload's history
+    (chaos/groups.py records these op shapes):
+
+    1. **No same-generation dual ownership** — `assignment` ops record
+       each member's observed (generation, partitions); two members of
+       the SAME group and generation claiming one partition is a
+       coordinator bug (the assignment is a deterministic function of
+       the replicated member set — overlap means divergent applies).
+       Cross-generation overlap is the normal handover and is fine.
+    2. **Acked group commits survive rebalance** — per (group, topic,
+       partition), acked commit offsets never move backward in recorded
+       order, ACROSS members: a partition's new owner resumes at-or-
+       after the old owner's last acked commit, and no later owner's
+       commit regresses it (the shared-offset contract generation
+       fencing protects).
+    3. **Stale-generation commits are refused** — a commit op marked
+       `stale=True` (the nemesis's commit-from-deposed-member op) that
+       was ACKED is a fencing hole; refusals are the required outcome.
+    """
+    violations: list[str] = []
+
+    # 1: same-generation ownership is disjoint across members.
+    owners: dict[tuple[str, int, str, int], set[str]] = {}
+    for op in ops:
+        if op.get("op") != "assignment":
+            continue
+        group, gen, member = op["group"], int(op["generation"]), op["member"]
+        for t, p in op.get("partitions", []):
+            key = (group, gen, t, int(p))
+            claimants = owners.setdefault(key, set())
+            claimants.add(member)
+            if len(claimants) > 1:
+                violations.append(
+                    f"dual ownership: {sorted(claimants)} both own "
+                    f"({t}, {p}) in {group} generation {gen}"
+                )
+
+    # 2: cross-member group commit monotonicity (recorded order).
+    committed: dict[tuple[str, str, int], int] = {}
+    for op in ops:
+        if (op.get("op") != "commit" or op.get("status") != "ok"
+                or op.get("group") is None):
+            continue
+        key = (op["group"], op["topic"], int(op["partition"]))
+        off = int(op["offset"])
+        # 3: an acked stale-generation commit is a fencing hole.
+        if op.get("stale"):
+            violations.append(
+                f"stale-generation commit ACKED for {key} at {off} "
+                f"(member {op.get('member')}, generation "
+                f"{op.get('generation')}): fencing hole"
+            )
+        if off < committed.get(key, 0):
+            violations.append(
+                f"group commit regressed for {key}: {off} < "
+                f"{committed[key]} (member {op.get('member')}) — an "
+                f"acked offset commit did not survive the rebalance"
+            )
+        committed[key] = max(committed.get(key, 0), off)
     return violations

@@ -61,6 +61,19 @@ _DISK_OPS = ("disk_torn", "disk_flip", "disk_trunc")
 
 _BACKEND_POOLS = {"inproc": _OP_WEIGHTS, "proc": _PROC_OP_WEIGHTS}
 
+# Rebalance-storm ops (runs with consumer-group members): client-side
+# and backend-agnostic — heartbeat silence (→ session eviction),
+# membership churn (leave+rejoin), and a commit stamped with a deposed
+# generation (the fence MUST refuse it). Joined to either backend's
+# pool when the run has group members.
+_GROUP_OP_WEIGHTS = (
+    ("member_pause", 2),
+    ("member_churn", 2),
+    ("stale_commit", 1),
+)
+
+_GROUP_OPS = tuple(n for n, _ in _GROUP_OP_WEIGHTS)
+
 
 def make_schedule(
     seed: int,
@@ -69,17 +82,21 @@ def make_schedule(
     ops_per_phase: int = 2,
     lockstep_workers: tuple[str, ...] = (),
     backend: str = "inproc",
+    group_members: int = 0,
 ) -> list[list[dict]]:
     """Deterministic [phases][ops] fault schedule. Each phase ends with
     an implicit heal (the nemesis records it in the trace), so phases
     start from a clean network with every broker up. `backend` selects
     the op pool ("inproc": network+crash faults; "proc": SIGKILL + disk
-    faults) — the schedule stays a pure function of (seed, roster,
-    shape, backend), so either backend's runs replay byte-for-byte."""
+    faults); `group_members > 0` joins the rebalance-storm ops to it —
+    the schedule stays a pure function of (seed, roster, shape,
+    backend, group_members), so any run replays byte-for-byte."""
     rng = random.Random(seed)
     pool = list(_BACKEND_POOLS[backend])
     if lockstep_workers and backend == "inproc":
         pool.append(("kill_worker", 1))
+    if group_members > 0:
+        pool.extend(_GROUP_OP_WEIGHTS)
     names = [n for n, w in pool for _ in range(w)]
     max_crashed = (len(broker_ids) - 1) // 2
     schedule: list[list[dict]] = []
@@ -127,6 +144,9 @@ def make_schedule(
             elif name == "kill_worker":
                 ops.append({"op": "kill_worker",
                             "worker": rng.choice(list(lockstep_workers))})
+            elif name in _GROUP_OPS:
+                ops.append({"op": name,
+                            "member": rng.randrange(group_members)})
         schedule.append(ops)
     return schedule
 
@@ -166,16 +186,23 @@ class Nemesis:
                  ops_per_phase: int = 2,
                  lockstep_workers: tuple[str, ...] = (),
                  schedule: Optional[list[list[dict]]] = None,
-                 backend: str = "inproc") -> None:
+                 backend: str = "inproc",
+                 group_members: int = 0) -> None:
         self.cluster = cluster
         self.seed = seed
         self.backend = backend
         self.lockstep_workers = tuple(lockstep_workers)
+        # Rebalance-storm target: a chaos.groups.GroupWorkload (or any
+        # object with pause/resume/churn/stale_commit/resume_all).
+        # Attached by the harness AFTER construction — the schedule only
+        # references member INDEXES, so purity is unaffected.
+        self.group_ops = None
         self.schedule = schedule if schedule is not None else make_schedule(
             seed, sorted(cluster.brokers), phases,
             ops_per_phase=ops_per_phase,
             lockstep_workers=self.lockstep_workers,
             backend=backend,
+            group_members=group_members,
         )
         self.trace: list[dict] = []
         # Disk-fault injection outcomes, parallel to the trace entries
@@ -222,6 +249,18 @@ class Nemesis:
             if b in self._crashed:
                 self._crashed.discard(b)
                 self.cluster.restart(b)
+            return
+        if kind in _GROUP_OPS:
+            # Rebalance-storm ops act on the group workload's members
+            # (client-side; no network hooks needed on either backend).
+            if self.group_ops is not None:
+                i = op["member"]
+                if kind == "member_pause":
+                    self.group_ops.pause(i)
+                elif kind == "member_churn":
+                    self.group_ops.churn(i)
+                elif kind == "stale_commit":
+                    self.group_ops.stale_commit(i)
             return
         if kind in _DISK_OPS:
             # Damage the crashed victim's on-disk store; the restart at
@@ -275,6 +314,10 @@ class Nemesis:
         if net is not None:
             for w in self.lockstep_workers:
                 net.set_up(w)
+        if self.group_ops is not None:
+            # Paused members resume (and transparently rejoin if their
+            # session lapsed and the coordinator evicted them mid-phase).
+            self.group_ops.resume_all()
         self.trace.append({"phase": phase, "op": "heal"})
         self._mark(phase, {"op": "heal"})
 

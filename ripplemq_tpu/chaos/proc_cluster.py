@@ -117,6 +117,8 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "election_timeout_s": config.election_timeout_s,
         "metadata_election_timeout_s": config.metadata_election_timeout_s,
         "membership_poll_s": config.membership_poll_s,
+        "group_session_timeout_s": config.group_session_timeout_s,
+        "group_retention_s": config.group_retention_s,
         "rpc_timeout_s": config.rpc_timeout_s,
         "standby_count": config.standby_count,
         "segment_bytes": config.segment_bytes,

@@ -17,6 +17,12 @@ Deliberate upgrades over the reference (documented deviations):
   its cache instead of failing the call.
 - `auto_commit=False` gives at-least-once consumption (the reference is
   hardwired to commit-after-read at-most-once, ConsumerClientImpl.java:103).
+- `idempotence=True` (default) makes clean produce acks EXACTLY-ONCE:
+  the producer registers a metadata-issued pid and stamps batches with
+  ack-gated sequences the broker dedupes (client/producer.py).
+- `GroupConsumer` (ripplemq_tpu.groups, re-exported here) adds the
+  consumer-group surface: membership, cooperative assignment,
+  generation-fenced shared offsets.
 """
 
 from ripplemq_tpu.client.metadata import MetadataManager
@@ -30,4 +36,17 @@ __all__ = [
     "RoundRobinSelector",
     "ProducerClient",
     "ConsumerClient",
+    "GroupConsumer",
 ]
+
+
+def __getattr__(name):
+    # Lazy: groups.client imports ConsumerClient from THIS package, so
+    # an eager re-export would cycle whenever ripplemq_tpu.groups loads
+    # first (e.g. `from ripplemq_tpu.groups import GroupConsumer` on a
+    # fresh interpreter).
+    if name == "GroupConsumer":
+        from ripplemq_tpu.groups.client import GroupConsumer
+
+        return GroupConsumer
+    raise AttributeError(name)

@@ -3,12 +3,24 @@
 API parity with the reference's ProducerClient/Impl (reference:
 mq-common/src/main/java/client/ProducerClientImpl.java:57-99): cached
 metadata, round-robin partition selection, leader-directed send, close().
-Upgrades: real batching (`produce_batch`), not-leader hint following, and
-honest address resolution (see package docstring).
+Upgrades: real batching (`produce_batch`), not-leader hint following,
+honest address resolution (see package docstring), and IDEMPOTENT
+produce (`idempotence=True`, the default): the client registers a
+metadata-issued producer id once, stamps every batch with an ack-gated
+per-partition sequence, and the broker's dedup table collapses replays —
+a retried batch whose first attempt actually committed is acked with its
+original offset instead of appending twice, including across controller
+failover. The sequence only ADVANCES on an acked outcome, so every
+retry of an unacked batch replays the same identity; a batch abandoned
+after its sequence was put on the wire burns its range (the broker may
+hold a settled entry for it — reusing the numbers for fresh payloads
+would dedupe them away).
 """
 
 from __future__ import annotations
 
+import threading
+import uuid
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
@@ -33,9 +45,22 @@ class ProducerClient:
         retry_backoff_s: float = 0.2,
         deadline_s: Optional[float] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        idempotence: bool = True,
+        producer_name: Optional[str] = None,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
+        # Idempotent-producer identity (see module docstring). The pid
+        # registers LAZILY on the first produce that can reach a broker;
+        # until then batches flow unstamped (at-least-once — the broker
+        # still stamps the forwarded hop with its own pid). The name
+        # embeds a per-instance nonce: a restarted producer's sequence
+        # counters start at zero, so it must not inherit an old pid.
+        self._idempotence = bool(idempotence)
+        self._pid: Optional[int] = None
+        self._pid_name = producer_name or f"producer/{uuid.uuid4().hex}"
+        self._seq_lock = threading.Lock()
+        self._seqs: dict[tuple[str, int], int] = {}
         self._selector = selector or RoundRobinSelector()
         self._timeout = rpc_timeout_s
         # One retry discipline for every operation (wire/retry.py):
@@ -67,28 +92,49 @@ class ProducerClient:
         """Send a batch to ONE partition; returns the first assigned
         offset. The batch rides a single RPC and as few device rounds as
         its size requires (vs. the reference's one message per RPC,
-        PartitionClient.java:39)."""
+        PartitionClient.java:39).
+
+        With idempotence on, the partition choice is PINNED for the
+        whole call and every retry replays the same (pid, seq): an
+        attempt whose response was lost but whose round committed is
+        acked as a duplicate by the broker's dedup table — the window
+        that used to make retried produces at-least-once. The sequence
+        range is reserved the first time it goes on the wire; a call
+        abandoned after that burns its range (see module docstring)."""
         if not messages:
             raise ValueError("empty batch")
         run = self._retry.begin()
+        pin = partition
+        pid = seq = None
+        n = len(messages)
         while run.attempt():
             t = self._meta.topic(topic)
             if t is None:
                 run.note(f"unknown topic {topic!r}")
                 self._refresh_quietly()
                 continue
-            pid = self._selector.select(t) if partition is None else partition
-            addr = self._meta.leader_addr(topic, pid)
+            if pin is None:
+                # One selector advance per CALL (not per attempt): a
+                # retry must replay the same partition, or the dedup
+                # identity — and the at-most-once-per-partition story —
+                # dissolves across attempts.
+                pin = self._selector.select(t)
+            addr = self._meta.leader_addr(topic, pin)
             if addr is None:
-                run.note(f"no leader known for {topic}[{pid}]")
+                run.note(f"no leader known for {topic}[{pin}]")
                 self._refresh_quietly()
                 continue
+            if self._idempotence and pid is None:
+                pid = self._ensure_pid(addr, run)
+                if pid is not None:
+                    seq = self._reserve_seq(topic, pin, n)
+            req = {"type": "produce", "topic": topic, "partition": pin,
+                   "messages": list(messages)}
+            if pid is not None:
+                req["pid"], req["seq"] = pid, seq
             try:
                 resp = self._transport.call(
-                    addr,
-                    {"type": "produce", "topic": topic, "partition": pid,
-                     "messages": list(messages)},
-                    timeout=run.clip(self._timeout),
+                    addr, req, timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
                 run.note(str(e))
@@ -106,6 +152,38 @@ class ProducerClient:
             if fatal_response_error(err):
                 raise ProduceError(err)  # terminal
         raise ProduceError(f"produce to {topic} failed: {run.summary()}")
+
+    def _reserve_seq(self, topic: str, partition: int, n: int) -> int:
+        """Reserve `n` sequence numbers for one batch (thread-safe).
+        Reservation happens once per call, right before the identity
+        first goes on the wire; retries replay it, abandonment burns it."""
+        with self._seq_lock:
+            seq = self._seqs.get((topic, partition), 0)
+            self._seqs[(topic, partition)] = seq + n
+        return seq
+
+    def _ensure_pid(self, addr: str, run) -> Optional[int]:
+        """Register this producer's id (once) with the metadata plane.
+        None on failure — the current call proceeds unstamped
+        (at-least-once, the pre-idempotence contract) and the next call
+        tries again; registration must never wedge the produce path
+        behind a leaderless metadata raft."""
+        if self._pid is not None:
+            return self._pid
+        try:
+            resp = self._transport.call(
+                addr,
+                {"type": "producer.register", "name": self._pid_name},
+                timeout=run.clip(self._timeout),
+            )
+        except RpcError as e:
+            run.note(f"pid registration: {e}")
+            return None
+        if resp.get("ok"):
+            self._pid = int(resp["pid"])
+            return self._pid
+        run.note(f"pid registration: {resp.get('error')}")
+        return None
 
     def produce_batch_async(self, topic: str, messages: list[bytes],
                             partition: Optional[int] = None):
@@ -134,6 +212,16 @@ class ProducerClient:
             raise ProduceError(f"no leader known for {topic}[{pid}]")
         req = {"type": "produce", "topic": topic, "partition": pid,
                "messages": list(messages)}
+        if self._idempotence:
+            if self._pid is None:
+                # One synchronous registration RPC on the first window;
+                # every later batch stamps from the cached pid. Failure
+                # leaves this batch unstamped (at-least-once), same as
+                # the sync path.
+                self._ensure_pid(addr, self._retry.begin())
+            if self._pid is not None:
+                req["pid"] = self._pid
+                req["seq"] = self._reserve_seq(topic, pid, len(messages))
         fut = call_async(addr, req)
 
         def wait() -> int:

@@ -1,0 +1,130 @@
+"""Replicated consumer-group state + the deterministic assignment rule.
+
+Everything here is applied inside the metadata Raft's state machine
+(broker/manager.py), so it must be a PURE function of replicated inputs:
+the member set (with subscriptions), the static topic table, and the
+previous assignment. Every broker's apply computes the identical
+assignment for the identical generation — there is no separate
+"assignment proposal" round trip, and a member learns its partitions
+from any broker's replicated view (join response / heartbeat).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ripplemq_tpu.metadata.models import GroupKey
+
+
+def group_consumer_name(group: str) -> str:
+    """The group's SHARED offset-tracking consumer name: all members
+    commit under it, so a partition moving between members resumes from
+    the group's last acked commit (one engine consumer slot per group,
+    not per member)."""
+    return f"g/{group}"
+
+
+@dataclasses.dataclass
+class GroupState:
+    """One group's replicated state. `members` maps member id → its
+    subscribed topics; `assignment` maps member id → assigned
+    (topic, partition) tuples, recomputed on every membership change
+    under a bumped `generation` (the fencing epoch)."""
+
+    name: str
+    generation: int = 0
+    members: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+    assignment: dict[str, tuple[GroupKey, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+    def owner_of(self, key: GroupKey) -> Optional[str]:
+        for member, keys in self.assignment.items():
+            if key in keys:
+                return member
+        return None
+
+    def to_wire(self) -> dict:
+        return {
+            "name": self.name,
+            "generation": self.generation,
+            "members": {m: list(ts) for m, ts in self.members.items()},
+            "assignment": {
+                m: [[t, p] for t, p in keys]
+                for m, keys in self.assignment.items()
+            },
+        }
+
+    @staticmethod
+    def from_wire(d: dict) -> "GroupState":
+        return GroupState(
+            name=str(d["name"]),
+            generation=int(d["generation"]),
+            members={
+                str(m): tuple(str(t) for t in ts)
+                for m, ts in d.get("members", {}).items()
+            },
+            assignment={
+                str(m): tuple((str(t), int(p)) for t, p in keys)
+                for m, keys in d.get("assignment", {}).items()
+            },
+        )
+
+
+def compute_assignment(
+    members: dict[str, tuple[str, ...]],
+    topic_partitions: dict[str, int],
+    previous: Optional[dict[str, tuple[GroupKey, ...]]] = None,
+) -> dict[str, tuple[GroupKey, ...]]:
+    """Deterministic STICKY assignment: per topic, partitions spread
+    evenly over the subscribing members (sorted by id), and a partition
+    stays with its previous owner whenever that owner is still
+    subscribed and under its even-split quota — the cooperative half of
+    a rebalance (membership churn moves the minimum number of
+    partitions, so an N-member storm does not reshuffle the world on
+    every join/leave). Pure function of its arguments: every broker's
+    metadata apply computes the identical map."""
+    previous = previous or {}
+    out: dict[str, list[GroupKey]] = {m: [] for m in members}
+    for topic in sorted(topic_partitions):
+        subs = sorted(m for m, ts in members.items() if topic in ts)
+        if not subs:
+            continue
+        nparts = topic_partitions[topic]
+        base, extra = divmod(nparts, len(subs))
+        # Even-split quota per member for THIS topic: the first `extra`
+        # members (sorted order) take one more.
+        quota = {m: base + (1 if i < extra else 0)
+                 for i, m in enumerate(subs)}
+        taken: dict[str, int] = {m: 0 for m in subs}
+        assigned: dict[GroupKey, str] = {}
+        # Sticky pass: keep previous owners under quota.
+        prev_owner = {
+            key: m
+            for m, keys in previous.items()
+            for key in keys
+            if key[0] == topic
+        }
+        for pid in range(nparts):
+            key = (topic, pid)
+            owner = prev_owner.get(key)
+            if owner in quota and taken[owner] < quota[owner]:
+                assigned[key] = owner
+                taken[owner] += 1
+        # Fill pass: orphaned partitions go to members under quota, in
+        # sorted order (deterministic).
+        for pid in range(nparts):
+            key = (topic, pid)
+            if key in assigned:
+                continue
+            for m in subs:
+                if taken[m] < quota[m]:
+                    assigned[key] = m
+                    taken[m] += 1
+                    break
+        for key, m in assigned.items():
+            out[m].append(key)
+    return {m: tuple(sorted(keys)) for m, keys in out.items()}

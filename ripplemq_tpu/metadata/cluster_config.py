@@ -39,6 +39,22 @@ class ClusterConfig:
     # Cadence of the metadata leader's assignment/controller planning
     # (BrokerServer._metadata_leader_duty).
     membership_poll_s: float = 10.0
+    # Consumer-group member session: a member whose heartbeat has not
+    # reached the metadata leader for this long is EVICTED (an
+    # OP_GROUP_LEAVE proposal — the group rebalances under a bumped
+    # generation and the member's later commits are fenced). Clients
+    # should heartbeat at a small fraction of this (GroupConsumer
+    # defaults to 0.5 s beats).
+    group_session_timeout_s: float = 3.0
+    # How long an EMPTY group is retained before the metadata leader
+    # reaps it (OP_GROUP_DELETE) and recycles its shared offset slot.
+    # Emptiness can be transient — a rebalance storm or a partition
+    # cutting every member off the heartbeat path — and reaping too
+    # eagerly resets the group's generation and offsets, re-delivering
+    # the whole log to the re-formed group (the randomized storm soak
+    # caught exactly that). Members rejoining within the window resume
+    # seamlessly.
+    group_retention_s: float = 60.0
     metadata_refresh_s: float = 10.0
     rpc_timeout_s: float = 3.0
     # The broker that BOOTSTRAPS as the TPU mesh driver (device-program
@@ -228,6 +244,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         "membership_poll_s",
         "metadata_refresh_s",
         "rpc_timeout_s",
+        "group_session_timeout_s",
+        "group_retention_s",
     )
     extra = {k: float(raw[k]) for k in timing_keys if k in raw}
     if raw.get("controller_id") is not None:

@@ -28,6 +28,13 @@ _log = get_logger("storage")
 REC_APPEND = 1
 REC_OFFSETS = 2
 REC_META = 3
+# Idempotent-producer dedup entries: one record per committed round and
+# slot, written immediately AFTER that slot's REC_APPEND (a torn tail
+# may drop the pid record but never leave it without its rows — the
+# reverse order would let a dedup-ack point at rows that were never
+# persisted). Payload: packed (pid u32, seq i64, rows u32, base i64)
+# per producer batch; `base` in the header carries the entry count.
+REC_PIDSEQ = 4
 
 _MAGIC = 0x474C5152
 _HEADER = struct.Struct("<IBIIII")  # magic, type, slot, base, len, crc

@@ -43,6 +43,13 @@ FATAL_ERROR_PREFIXES = (
     "unknown_partition",
     "consumer_table_full",
     "unknown request type",
+    # Consumer-group fencing: retrying a stale-generation commit (or a
+    # membership the coordinator evicted) can never succeed — the member
+    # must REJOIN and act under the new generation. The group SDK maps
+    # these to FencedError / a transparent rejoin; a blind retry loop
+    # would just hammer the fence.
+    "fenced_generation",
+    "unknown_member",
 )
 
 

@@ -47,3 +47,32 @@ def test_randomized_soak_seed(seed):
         f"seed {seed} unconverged: {verdict['convergence']}\n"
         f"trace: {trace_json(verdict['trace'])}"
     )
+
+
+# Rebalance-storm soak: network faults AND group ops drawn from one
+# seeded pool, a 3-member group polling throughout. The group
+# invariants (no same-generation dual ownership, acked commits survive
+# rebalance, stale commits fenced, bounded post-storm convergence) ride
+# in run_chaos's verdict; widen with CHAOS_SOAK_SEEDS as above.
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_randomized_group_storm_seed(seed):
+    verdict = run_chaos(
+        seed=seed,
+        n_brokers=3,
+        partitions=3,
+        phases=3,
+        phase_s=0.8,
+        ops_per_phase=3,
+        groups=3,
+        converge_timeout_s=60.0,
+    )
+    assert verdict["violations"] == [], (
+        f"seed {seed}: {verdict['violations']}\n"
+        f"replay: python profiles/chaos_soak.py --seed {seed} "
+        f"--partitions 3 --phases 3 --ops-per-phase 3 --groups 3\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )
+    assert verdict["converged"] and verdict["group"]["converged"], (
+        f"seed {seed} unconverged: {verdict['convergence']} / "
+        f"{verdict['group']}\ntrace: {trace_json(verdict['trace'])}"
+    )

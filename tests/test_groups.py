@@ -1,0 +1,265 @@
+"""Consumer groups: assignment determinism/stickiness, the coordinator
+state machine, generation fencing, heartbeat eviction, and consumer-slot
+recycling (ISSUE 7 tentpole + slot-recycle satellite)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ripplemq_tpu.groups.coordinator import GroupLiveness, GroupTable
+from ripplemq_tpu.groups.state import compute_assignment
+from tests.helpers import wait_until
+
+
+# -------------------------------------------------- assignment function
+
+
+def test_assignment_is_balanced_and_deterministic():
+    members = {"a": ("t",), "b": ("t",), "c": ("t",)}
+    parts = {"t": 6}
+    out = compute_assignment(members, parts)
+    assert out == compute_assignment(members, parts)  # pure function
+    sizes = {m: len(k) for m, k in out.items()}
+    assert sizes == {"a": 2, "b": 2, "c": 2}
+    union = [k for keys in out.values() for k in keys]
+    assert sorted(union) == [("t", p) for p in range(6)]  # disjoint cover
+
+
+def test_assignment_is_sticky_under_churn():
+    parts = {"t": 6}
+    two = compute_assignment({"a": ("t",), "b": ("t",)}, parts)
+    three = compute_assignment(
+        {"a": ("t",), "b": ("t",), "c": ("t",)}, parts, previous=two
+    )
+    # Cooperative: each incumbent keeps its (now reduced) quota — at
+    # most one partition moves per incumbent, never a full reshuffle.
+    for m in ("a", "b"):
+        kept = set(three[m]) & set(two[m])
+        assert len(kept) == len(three[m]), (two, three)
+    assert len(three["c"]) == 2
+
+
+def test_assignment_respects_subscriptions():
+    out = compute_assignment(
+        {"a": ("t1",), "b": ("t2",), "c": ("t1", "t2")},
+        {"t1": 2, "t2": 2},
+    )
+    assert all(k[0] == "t1" for k in out["a"])
+    assert all(k[0] == "t2" for k in out["b"])
+    union = sorted(k for keys in out.values() for k in keys)
+    assert union == [("t1", 0), ("t1", 1), ("t2", 0), ("t2", 1)]
+
+
+# ----------------------------------------------------------- group table
+
+
+def test_group_table_generations_and_idempotent_join():
+    t = GroupTable()
+    parts = {"t": 4}
+    st, changed = t.join("g", "m1", ("t",), parts)
+    assert changed and st.generation == 1
+    st, changed = t.join("g", "m2", ("t",), parts)
+    assert changed and st.generation == 2
+    # Re-join with the same subscription: a retried/duplicated proposal
+    # must NOT churn the generation.
+    st, changed = t.join("g", "m2", ("t",), parts)
+    assert not changed and st.generation == 2
+    st, changed, emptied = t.leave("g", "m1", parts)
+    assert changed and not emptied and st.generation == 3
+    assert set(st.assignment["m2"]) == {("t", p) for p in range(4)}
+    # An EMPTIED group is retained — generation monotone, identity
+    # intact (a transient total-churn must not reset offsets); only an
+    # explicit delete (the retention reap) drops it, and only while it
+    # is still empty.
+    st, changed, emptied = t.leave("g", "m2", parts)
+    assert changed and emptied and t.state("g") is not None
+    assert t.state("g").generation == 4 and t.empty_groups() == ["g"]
+    st, changed = t.join("g", "m3", ("t",), parts)
+    assert st.generation == 5  # never back to 1
+    assert not t.delete("g")   # occupied: the rejoin won the race
+    t.leave("g", "m3", parts)
+    assert t.delete("g") and t.state("g") is None
+    # Wire round-trip (snapshot/restore path).
+    t.join("h", "x", ("t",), parts)
+    t2 = GroupTable.from_wire(t.to_wire())
+    assert t2.state("h").generation == 1
+    assert t2.state("h").assignment == t.state("h").assignment
+
+
+def test_liveness_grace_and_eviction():
+    clock = [0.0]
+    lv = GroupLiveness(clock=lambda: clock[0])
+    t = GroupTable()
+    t.join("g", "m1", ("t",), {"t": 2})
+    t.join("g", "m2", ("t",), {"t": 2})
+    # First sighting seeds the grace window — no day-zero evictions.
+    assert lv.plan_evictions(t, 3.0) == []
+    clock[0] = 2.0
+    lv.beat("g", "m1")
+    clock[0] = 4.0
+    # m2 never beat (grace started at 0): evicted. m1 beat at 2: alive.
+    assert lv.plan_evictions(t, 3.0) == [("g", "m2")]
+    # Stamps for members gone from the table are pruned.
+    t.leave("g", "m2", {"t": 2})
+    assert lv.plan_evictions(t, 3.0) == []
+
+
+# --------------------------------------------------- cluster integration
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_cluster_config(
+        3, topics=(Topic("t", 4, 3),),
+        group_session_timeout_s=0.8,
+        # Short empty-group retention so the slot-recycle test's
+        # ephemeral groups reap inside the test budget (production
+        # default is 60 s — transient total-churn keeps the group).
+        group_retention_s=0.4,
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        yield c
+
+
+def test_join_rebalance_fence_and_eviction(cluster):
+    from ripplemq_tpu.client import GroupConsumer, ProducerClient
+    from ripplemq_tpu.groups.client import FencedError
+
+    c = cluster
+    bootstrap = [b.address for b in c.config.brokers]
+    g1 = GroupConsumer(bootstrap, "cg", topics=["t"], member_id="m1",
+                       transport=c.client("g1"), heartbeat_s=0.2)
+    g2 = GroupConsumer(bootstrap, "cg", topics=["t"], member_id="m2",
+                       transport=c.client("g2"), heartbeat_s=0.2)
+    try:
+        a1 = g1.join()
+        g2.join()
+        g1.heartbeat(force=True)  # adopt the post-m2 generation
+        assert g1.generation == g2.generation
+        # Disjoint cover of all 4 partitions, 2 each (balanced).
+        union = list(g1.assignment) + list(g2.assignment)
+        assert sorted(union) == [("t", p) for p in range(4)]
+        assert len(g1.assignment) == len(g2.assignment) == 2
+        del a1
+
+        # The group consumes through SHARED offsets: a message lands
+        # with whoever owns its partition, exactly once.
+        p = ProducerClient(bootstrap, transport=c.client("gp"))
+        for pid in range(4):
+            p.produce("t", f"msg-{pid}".encode(), partition=pid)
+        got = []
+        deadline = time.time() + 20
+        while len(got) < 4 and time.time() < deadline:
+            for g in (g1, g2):
+                _, msgs = g.poll()
+                got.extend(msgs)
+        assert sorted(got) == [f"msg-{pid}".encode() for pid in range(4)]
+        p.close()
+
+        # Stale-generation commit: typed refusal, never an overwrite.
+        topic, pid = g1.assignment[0]
+        with pytest.raises(FencedError):
+            g1.commit(topic, pid, 0, generation=g1.generation - 1)
+
+        # Heartbeat eviction: m2 goes silent past the session timeout;
+        # the coordinator evicts it and m1 absorbs all partitions.
+        gen_before = g1.generation
+        def m1_owns_everything():
+            g1.heartbeat(force=True)
+            return len(g1.assignment) == 4
+        assert wait_until(m1_owns_everything, timeout=20)
+        assert g1.generation > gen_before
+        # The evicted member's next heartbeat rejoins transparently.
+        g2.heartbeat(force=True)
+        assert g2.generation >= g1.generation
+        assert wait_until(
+            lambda: (g1.heartbeat(force=True) or True)
+            and len(g1.assignment) == 2 and len(g2.assignment) == 2,
+            timeout=20,
+        )
+    finally:
+        g1.close()
+        g2.close()
+
+
+def test_group_dissolution_recycles_consumer_slot(cluster):
+    """Slot-recycle satellite: groups come and go without exhausting
+    the fixed [P, C] consumer table — the dissolved group's shared slot
+    is released, reset (offset rows zeroed through real rounds), and
+    reallocated; and the exhaustion refusal still fires when the table
+    truly fills. Failing-before: `_apply_register_consumer` bound slots
+    permanently, so C distinct group lifetimes bricked the table."""
+    from ripplemq_tpu.client import GroupConsumer, ProducerClient
+    from ripplemq_tpu.groups.state import group_consumer_name
+
+    c = cluster
+    bootstrap = [b.address for b in c.config.brokers]
+    C = c.config.engine.max_consumers
+    p = ProducerClient(bootstrap, transport=c.client("slotp"))
+    p.produce("t", b"slot-test", partition=0)
+
+    # Churn MORE groups through the table than it has slots. Each group
+    # joins, consumes (committing a nonzero offset into its slot), and
+    # dissolves; the recycle duty must keep up.
+    ctrl = next(b for b in c.brokers.values() if b.is_controller)
+    for i in range(C + 2):
+        g = GroupConsumer(bootstrap, f"ephemeral-{i}", topics=["t"],
+                          member_id="m", transport=c.client(f"eg{i}"),
+                          heartbeat_s=0.2)
+        g.join()
+        # Drive one committed offset so the slot is genuinely dirty.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            key, msgs = g.poll()
+            if msgs:
+                break
+        g.close()  # leave → empty → retention reap → release
+        # Wait for the slot to recycle (reaped, released AND reset)
+        # before the next group needs one — the table holds C slots.
+        name = group_consumer_name(f"ephemeral-{i}")
+        assert wait_until(
+            lambda: name not in ctrl.manager.consumers
+            and not ctrl.manager.dirty_slots(),
+            timeout=30,
+        ), (ctrl.manager.consumers, ctrl.manager.dirty_slots())
+
+    # A fresh group still registers fine (the table recycled), and its
+    # reset slot serves offset 0 — NOT the previous tenant's position.
+    g = GroupConsumer(bootstrap, "fresh", topics=["t"], member_id="m",
+                      transport=c.client("fresh"), heartbeat_s=0.2)
+    g.join()
+    deadline = time.time() + 15
+    seen = []
+    while time.time() < deadline and b"slot-test" not in seen:
+        key, msgs = g.poll()
+        seen.extend(msgs)
+    assert b"slot-test" in seen, (
+        "fresh group did not restart at offset 0 — recycled slot "
+        "leaked the previous tenant's committed position"
+    )
+    g.close()
+    p.close()
+
+    # Exhaustion refusal intact: fill the table with PERSISTENT plain
+    # consumers and watch the typed refusal (not a timeout).
+    cl = c.client("filler")
+    used = len(ctrl.manager.consumers)
+    refused = None
+    for i in range(C - used + 1):
+        resp = cl.call(
+            c.leader_broker("t", 0).addr,
+            {"type": "offset.commit", "topic": "t", "partition": 0,
+             "consumer": f"filler-{i}", "offset": 0},
+            timeout=10.0,
+        )
+        if not resp.get("ok"):
+            refused = resp
+            break
+    assert refused is not None
+    assert refused["error"].startswith("consumer_table_full"), refused

@@ -1,0 +1,320 @@
+"""Idempotent producers: (pid, seq) dedup at the append path, the
+replicated dedup table, and its recovery across boot replay and
+controller failover (ISSUE 7 tentpole + directed-test satellite).
+
+The failing-before shape of every test here: without the dedup plane a
+replayed produce appends a second copy — the exact at-least-once window
+that forced the PR 2 chaos checker to SUSPEND clean-ack exactly-once
+under wire-dup schedules (the suspension branch is now deleted;
+tests/test_chaos.py asserts the schedule-level half)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from ripplemq_tpu.broker.dataplane import (
+    DataPlane,
+    NotCommittedError,
+    recover_image,
+)
+from ripplemq_tpu.storage.segment import SegmentStore
+from tests.helpers import small_cfg, wait_until
+
+
+@pytest.fixture()
+def dp():
+    plane = DataPlane(small_cfg(), mode="local", max_retry_rounds=3)
+    plane.start()
+    yield plane
+    plane.stop()
+
+
+def _read_all(dp, slot, replica=0, start=0):
+    msgs, offset = [], start
+    while True:
+        got, nxt = dp.read(slot, offset, replica=replica)
+        if nxt == offset:
+            return msgs
+        msgs.extend(got)
+        offset = nxt
+
+
+# ----------------------------------------------------------- dedup basics
+
+
+def test_replayed_sequence_acks_with_original_base(dp):
+    dp.set_leader(0, 0, 1)
+    base = dp.submit_append(0, [b"a", b"b"], pid=7, seq=0).result(timeout=10)
+    # The replay (same pid/seq/len): acked with the SAME base, no second
+    # append — the log holds one copy.
+    dup = dp.submit_append(0, [b"a", b"b"], pid=7, seq=0).result(timeout=10)
+    assert dup == base
+    assert _read_all(dp, 0) == [b"a", b"b"]
+    # A FRESH sequence from the same producer appends normally.
+    nxt = dp.submit_append(0, [b"c"], pid=7, seq=2).result(timeout=10)
+    assert nxt > base
+    assert _read_all(dp, 0) == [b"a", b"b", b"c"]
+    assert dp.pid_table_size() == 1
+
+
+def test_duplicate_below_window_acks_with_unknown_base(dp):
+    dp.set_leader(1, 0, 1)
+    dp.submit_append(1, [b"x"], pid=9, seq=0).result(timeout=10)
+    dp.submit_append(1, [b"y"], pid=9, seq=1).result(timeout=10)
+    # A replay that is fully covered but matches no exact entry (client
+    # re-chunked differently): still refused-as-duplicate — base -1
+    # (present, position forgotten) rather than a second append.
+    got = dp.submit_append(1, [b"x", b"y"], pid=9, seq=0).result(timeout=10)
+    assert got == -1
+    assert _read_all(dp, 1) == [b"x", b"y"]
+
+
+def test_sequence_gap_is_accepted_as_new(dp):
+    # Dedup never refuses FRESH data: a gap above the table's end (an
+    # at-least-once fallback after an abandoned batch burned its range)
+    # appends normally.
+    dp.set_leader(2, 0, 1)
+    dp.submit_append(2, [b"a"], pid=3, seq=0).result(timeout=10)
+    dp.submit_append(2, [b"later"], pid=3, seq=100).result(timeout=10)
+    assert _read_all(dp, 2) == [b"a", b"later"]
+
+
+def test_concurrent_duplicate_attaches_to_inflight_round(dp):
+    # The wire-dup shape: the same request delivered twice while the
+    # first round is still in flight — both callers get ONE outcome.
+    dp.set_leader(3, 0, 1)
+    f1 = dp.submit_append(3, [b"w"], pid=5, seq=0)
+    f2 = dp.submit_append(3, [b"w"], pid=5, seq=0)
+    assert f2 is f1  # attached, not re-queued
+    assert f1.result(timeout=10) == f2.result(timeout=10)
+    assert _read_all(dp, 3) == [b"w"]
+
+
+def test_failed_round_clears_inflight_so_retry_reappends():
+    cfg = small_cfg()
+    dp = DataPlane(cfg, mode="local", max_retry_rounds=2)
+    dp.start()
+    try:
+        # Leaderless slot: the round cannot commit; the retry budget
+        # exhausts and the future fails.
+        with pytest.raises(NotCommittedError):
+            dp.submit_append(0, [b"r"], pid=4, seq=0).result(timeout=30)
+        # The in-flight dedup entry must be GONE: the client's retry is
+        # a real append once the slot heals, not an attach to a dead
+        # future (and not a false duplicate).
+        dp.set_leader(0, 0, 1)
+        assert dp.submit_append(0, [b"r"], pid=4, seq=0).result(
+            timeout=10
+        ) == 0
+        assert _read_all(dp, 0) == [b"r"]
+    finally:
+        dp.stop()
+
+
+# ---------------------------------------------- recovery: boot replay
+
+
+def test_boot_replay_rebuilds_dedup_table(tmp_path):
+    """Directed satellite: a producer retry straddling a BOOT REPLAY is
+    acked exactly once — the REC_PIDSEQ records persisted beside the
+    rows rebuild the table. Failing-before: a restarted plane would
+    re-append the replay (two copies of b'once')."""
+    cfg = small_cfg()
+    store = SegmentStore(str(tmp_path / "segments"), use_native=False)
+    dp = DataPlane(cfg, mode="local", store=store)
+    dp.start()
+    dp.set_leader(0, 0, 1)
+    base = dp.submit_append(0, [b"once"], pid=11, seq=0).result(timeout=10)
+    dp.stop()
+    store.close()
+
+    store2 = SegmentStore(str(tmp_path / "segments"), use_native=False)
+    pid_tab = {}
+    image = recover_image(cfg, str(tmp_path / "segments"),
+                          use_native=False, pid_tab_out=pid_tab)
+    assert (11, 0) in pid_tab, pid_tab
+    dp2 = DataPlane(cfg, mode="local", store=store2)
+    dp2.install(image, pid_table=pid_tab)
+    dp2.start()
+    try:
+        dp2.set_leader(0, 0, 2)
+        dup = dp2.submit_append(0, [b"once"], pid=11, seq=0).result(
+            timeout=10
+        )
+        assert dup == base
+        assert _read_all(dp2, 0) == [b"once"]
+        assert dp2.pid_table_size() == 1
+    finally:
+        dp2.stop()
+        store2.close()
+
+
+# ------------------------------------- recovery: controller failover
+
+
+def test_retry_straddling_controller_failover_acked_once():
+    """Directed satellite, the failover half: a produce acked by the OLD
+    controller is replayed (same pid/seq) against the PROMOTED one —
+    the dedup table rebuilt from the standby's committed-round stream
+    refuses the re-append. Failing-before: the promoted plane had no
+    table and the partition drained two copies."""
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.client import ConsumerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_cluster_config(
+        3, topics=(Topic("t", 1, 3),), standby_count=2,
+    )
+    with InProcCluster(config) as c:
+        c.wait_for_leaders()
+        client = c.client("idem")
+        # A joined standby is the promotion precondition.
+        assert wait_until(c.controller_ready, timeout=30)
+        # Register a producer id through the replicated path.
+        resp = client.call(
+            c.brokers[0].addr,
+            {"type": "producer.register", "name": "idem-prod"},
+            timeout=10.0,
+        )
+        assert resp["ok"], resp
+        pid = resp["pid"]
+        leader = c.leader_broker("t", 0)
+        req = {"type": "produce", "topic": "t", "partition": 0,
+               "messages": [b"straddle"], "pid": pid, "seq": 0}
+        r1 = client.call(leader.addr, dict(req), timeout=10.0)
+        assert r1["ok"], r1
+
+        # Kill the controller; a standby promotes and boots from its
+        # copy of the committed-round stream (REC_PIDSEQ included).
+        ctrl_id = c.brokers[0].manager.current_controller()
+        c.kill(ctrl_id)
+
+        def promoted():
+            for i, b in c.brokers.items():
+                if i == ctrl_id:
+                    continue
+                if (b.manager.current_controller() != ctrl_id
+                        and b._local_engine() is not None):
+                    return True
+            return False
+
+        assert wait_until(promoted, timeout=60)
+        # The retry: same (pid, seq), sent to whoever leads now.
+        r2 = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            survivor = next(
+                b for i, b in c.brokers.items() if i != ctrl_id
+            )
+            leader_id = survivor.manager.leader_of(("t", 0))
+            if leader_id is None or leader_id == ctrl_id:
+                time.sleep(0.1)
+                continue
+            got = client.call(c.brokers[leader_id].addr, dict(req),
+                              timeout=5.0)
+            if got.get("ok"):
+                r2 = got
+                break
+            time.sleep(0.1)
+        assert r2 is not None and r2["ok"], r2
+        assert r2["base_offset"] == r1["base_offset"]
+
+        # The drained log holds exactly ONE copy.
+        cc = ConsumerClient(
+            [b.address for b in config.brokers], "idem-audit",
+            transport=c.client("idem-audit"), retries=5,
+            retry_backoff_s=0.05,
+        )
+        msgs = []
+        for _ in range(20):
+            got = cc.consume("t", partition=0, max_messages=32)
+            if not got:
+                break
+            msgs += got
+        cc.close()
+        assert msgs.count(b"straddle") == 1, msgs
+
+
+# -------------------------------------------- client-side seq semantics
+
+
+class _ScriptedTransport:
+    """Transport double: serves metadata + registration, then runs a
+    script of produce outcomes ("timeout" | "ok") while recording every
+    produce request — the (pid, seq) replay contract is asserted on the
+    recorded stream."""
+
+    def __init__(self, script):
+        from ripplemq_tpu.wire.transport import RpcTimeout
+
+        self._timeout_exc = RpcTimeout
+        self.script = list(script)
+        self.produces: list[dict] = []
+        self.next_offset = 0
+
+    def call(self, addr, request, timeout=3.0):
+        t = request.get("type")
+        if t == "meta.topics":
+            return {
+                "ok": True,
+                "topics": [{
+                    "name": "t", "partitions": 1,
+                    "replication_factor": 1,
+                    "assignments": [{"partition_id": 0, "replicas": [0],
+                                     "leader": 0, "term": 1}],
+                }],
+                "brokers": [{"broker_id": 0, "host": "h", "port": 1}],
+            }
+        if t == "producer.register":
+            return {"ok": True, "pid": 42}
+        if t == "produce":
+            self.produces.append(dict(request))
+            outcome = self.script.pop(0) if self.script else "ok"
+            if outcome == "timeout":
+                raise self._timeout_exc("scripted timeout")
+            base = self.next_offset
+            self.next_offset += len(request["messages"])
+            return {"ok": True, "base_offset": base,
+                    "count": len(request["messages"])}
+        return {"ok": False, "error": f"unknown request type {t!r}"}
+
+    def close(self):
+        pass
+
+
+def test_producer_client_replays_same_identity_across_retries():
+    from ripplemq_tpu.client import ProducerClient
+
+    tr = _ScriptedTransport(["timeout", "ok", "ok"])
+    p = ProducerClient(["h:1"], transport=tr, retries=3,
+                       retry_backoff_s=0.0, metadata_refresh_s=3600.0)
+    p.produce("t", b"m1", partition=0)
+    # Attempt 1 timed out (outcome unknown), attempt 2 succeeded: BOTH
+    # carried the identical (pid, seq) — the replay the broker dedupes.
+    assert len(tr.produces) == 2
+    assert tr.produces[0]["pid"] == tr.produces[1]["pid"] == 42
+    assert tr.produces[0]["seq"] == tr.produces[1]["seq"] == 0
+    # The next batch takes the NEXT sequence range.
+    p.produce_batch("t", [b"m2", b"m3"], partition=0)
+    assert tr.produces[2]["seq"] == 1
+    p.close()
+
+
+def test_producer_client_burns_sequence_range_on_abandonment():
+    from ripplemq_tpu.client import ProducerClient
+    from ripplemq_tpu.client.producer import ProduceError
+
+    tr = _ScriptedTransport(["timeout", "timeout", "ok"])
+    p = ProducerClient(["h:1"], transport=tr, retries=2,
+                       retry_backoff_s=0.0, metadata_refresh_s=3600.0)
+    with pytest.raises(ProduceError):
+        p.produce("t", b"doomed", partition=0)
+    # Two attempts went on the wire with seq 0; the range is BURNED —
+    # the next (fresh) payload must NOT reuse it, or a late-committing
+    # copy of "doomed" would dedupe the fresh batch away.
+    p.produce("t", b"fresh", partition=0)
+    assert tr.produces[-1]["seq"] == 1
+    assert tr.produces[-1]["messages"] == [b"fresh"]
+    p.close()

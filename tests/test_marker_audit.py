@@ -46,7 +46,9 @@ FAST_MODULES = {
     "test_degradation",
     "test_failover",
     "test_graft",
+    "test_groups",              # ~30 s: coordinator units + one cluster run
     "test_hostraft",
+    "test_idempotence",         # ~25 s: dedup units + failover replay
     "test_linearizable_reads",  # ~25 s: staged stale-controller clusters
     "test_log_matching",
     "test_marker_audit",
@@ -72,6 +74,7 @@ FAST_MODULES = {
     "test_spmd",
     "test_storage",
     "test_store_gc",            # ~17 s: GC/retention store churn
+    "test_store_migrate",
     "test_stride_rule",
     "test_wire",
 }

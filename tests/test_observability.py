@@ -223,15 +223,22 @@ STATS_TOP_KEYS = {
     "ok", "broker", "address", "boot_failures", "store_quarantined",
     "metadata", "controller", "topics", "live", "duty_errors",
     "erasure_errors", "engine",
+    # ISSUE 7: consumer groups (per-group generation + membership),
+    # the idempotent-producer registry size, and recycled consumer
+    # slots awaiting their offset reset.
+    "groups", "producer_ids", "dirty_consumer_slots",
 }
 STATS_ENGINE_KEYS = {
     "mode", "rounds", "dispatches", "read_queries", "read_dispatches",
     "read_cache_hits", "mirror_gap_slots", "settled_gap_slots",
     "stalled_slots", "committed_entries", "step_errors", "settle",
     "partitions", "degraded_slots", "degraded",
+    # ISSUE 7: producer-dedup table occupancy ((pid, partition) keys).
+    "pid_table_size",
 }
 STATS_SETTLE_KEYS = {"window", "occupancy_mean", "samples",
                      "backpressure_waits"}
+STATS_GROUP_KEYS = {"generation", "members", "partitions"}
 
 
 def test_admin_stats_schema_lock():
@@ -253,6 +260,24 @@ def test_admin_stats_schema_lock():
         assert set(stats["metadata"]) == {"role", "term", "leader_hint"}
         assert set(stats["controller"]) == {"id", "epoch", "standbys",
                                             "is_self"}
+        # Group entries are exact-keyed too (empty dict when no groups
+        # exist; populated shape pinned by registering one member).
+        assert stats["groups"] == {}
+        assert isinstance(stats["producer_ids"], int)
+        assert stats["dirty_consumer_slots"] == []
+        resp = client.call(
+            ctrl.addr,
+            {"type": "group.join", "group": "schema-g", "member": "m0",
+             "topics": ["topic1"]},
+            timeout=10.0,
+        )
+        assert resp["ok"], resp
+        stats = client.call(ctrl.addr, {"type": "admin.stats"},
+                            timeout=5.0)
+        assert set(stats["groups"]) == {"schema-g"}
+        assert set(stats["groups"]["schema-g"]) == STATS_GROUP_KEYS
+        assert stats["groups"]["schema-g"]["generation"] == 1
+        assert stats["groups"]["schema-g"]["members"] == ["m0"]
         # `slots` is additive (request-gated), not schema drift.
         detail = client.call(ctrl.addr,
                              {"type": "admin.stats", "slots": [0]},

@@ -1,0 +1,149 @@
+"""Store frame-CRC migration (profiles/store_migrate.py): a pre-PR-4
+payload-only-CRC store rewrites to header-covered framing, verified by
+verify_store — the upgrade path the deliberately unversioned format
+break needs (ROADMAP carried residual)."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from profiles.store_migrate import MigrationError, migrate_store
+from ripplemq_tpu.storage.segment import (
+    REC_APPEND,
+    REC_OFFSETS,
+    CorruptStoreError,
+    scan_store,
+    verify_store,
+)
+
+_HEADER_PREFIX = struct.Struct("<IBIII")
+_CRC = struct.Struct("<I")
+_MAGIC = 0x474C5152
+
+
+def _legacy_frame(rec_type: int, slot: int, base: int,
+                  payload: bytes) -> bytes:
+    """A frame exactly as the pre-PR-4 writer framed it: crc over the
+    PAYLOAD only."""
+    hdr = _HEADER_PREFIX.pack(_MAGIC, rec_type, slot, base, len(payload))
+    return hdr + _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def _write_legacy_store(directory: str,
+                        records: list[tuple[int, int, int, bytes]],
+                        per_segment: int = 3) -> None:
+    os.makedirs(directory, exist_ok=True)
+    seg = -1
+    f = None
+    for i, rec in enumerate(records):
+        if i % per_segment == 0:
+            if f is not None:
+                f.close()
+            seg += 1
+            f = open(os.path.join(directory, f"segment-{seg:08d}.log"),
+                     "wb")
+        f.write(_legacy_frame(*rec))
+    if f is not None:
+        f.close()
+
+
+RECORDS = [
+    (REC_APPEND, 0, 0, b"\x00" * 64),
+    (REC_APPEND, 1, 0, b"\x01" * 64),
+    (REC_OFFSETS, 0, 1, struct.pack("<II", 2, 8)),
+    (REC_APPEND, 0, 2, b"\x02" * 64),
+    (REC_APPEND, 1, 2, b"\x03" * 128),
+]
+
+
+def test_legacy_store_fails_modern_walk_then_migrates(tmp_path):
+    d = str(tmp_path / "segments")
+    _write_legacy_store(d, RECORDS)
+    # Failing-before: the modern health walk refuses legacy frames
+    # (sealed-segment corruption — exactly why the upgrade path exists).
+    with pytest.raises(CorruptStoreError):
+        verify_store(d)
+    stats = migrate_store(d)
+    assert stats["migrated"] and stats["legacy_frames"] == len(RECORDS)
+    # Passing-after: the modern walk accepts the rewrite…
+    assert verify_store(d) == len(RECORDS)
+    # …the records round-trip byte-identically…
+    assert list(scan_store(d, use_native=False)) == RECORDS
+    # …segment boundaries survive, and the original bytes are kept.
+    assert sorted(x for x in os.listdir(d) if x.endswith(".log")) == [
+        "segment-00000000.log", "segment-00000001.log"
+    ]
+    assert stats["backup"] and os.path.isdir(stats["backup"])
+
+
+def test_modern_store_is_a_noop_and_mixed_frames_migrate(tmp_path):
+    from ripplemq_tpu.storage.segment import SegmentStore
+
+    d = str(tmp_path / "segments")
+    store = SegmentStore(d, use_native=False)
+    for rec in RECORDS:
+        store.append(*rec)
+    store.close()
+    stats = migrate_store(d)
+    assert not stats["migrated"] and stats["modern_frames"] == len(RECORDS)
+    assert stats["legacy_frames"] == 0
+    # Mixed store (a deployment that crashed mid-upgrade and appended
+    # modern frames after legacy ones): everything lands header-covered.
+    with open(os.path.join(d, sorted(
+        x for x in os.listdir(d) if x.endswith(".log")
+    )[-1]), "ab") as f:
+        f.write(_legacy_frame(REC_APPEND, 2, 0, b"\x04" * 64))
+    stats = migrate_store(d)
+    assert stats["migrated"] and stats["legacy_frames"] == 1
+    assert verify_store(d) == len(RECORDS) + 1
+
+
+def test_torn_tail_dropped_but_midfile_rot_refused(tmp_path):
+    d = str(tmp_path / "segments")
+    _write_legacy_store(d, RECORDS, per_segment=10)  # one segment
+    path = os.path.join(d, "segment-00000000.log")
+    with open(path, "ab") as f:
+        f.write(b"\x13\x37torn")  # torn tail garbage
+    stats = migrate_store(d)
+    assert stats["migrated"] and stats["legacy_frames"] == len(RECORDS)
+    assert list(scan_store(d, use_native=False)) == RECORDS  # tail gone
+    # Mid-file rot (valid frames after the damage) must REFUSE — the
+    # migration is for format conversion, not corruption laundering.
+    d2 = str(tmp_path / "rot")
+    _write_legacy_store(d2, RECORDS, per_segment=10)
+    p2 = os.path.join(d2, "segment-00000000.log")
+    blob = bytearray(open(p2, "rb").read())
+    blob[40] ^= 0xFF  # flip a byte inside the first record's payload
+    open(p2, "wb").write(bytes(blob))
+    with pytest.raises(MigrationError):
+        migrate_store(d2)
+    # Untouched on failure.
+    assert open(p2, "rb").read() == bytes(blob)
+
+
+def test_migrated_store_boots_a_dataplane(tmp_path):
+    """End to end: a legacy store holding REAL round records (engine-
+    shaped rows) migrates, then boots a plane via recover_image —
+    the actual upgrade sequence an operator runs."""
+    import numpy as np
+
+    from ripplemq_tpu.broker.dataplane import recover_image
+    from tests.helpers import small_cfg
+
+    cfg = small_cfg(partitions=2, replicas=3)
+    rows = np.zeros((8, cfg.slot_bytes), np.uint8)
+    rows[:, 0] = 4  # row length header: 4 payload bytes
+    rows[:, 8:12] = 7
+    d = str(tmp_path / "segments")
+    _write_legacy_store(d, [
+        (REC_APPEND, 0, 0, rows.tobytes()),
+        (REC_OFFSETS, 1, 1, struct.pack("<II", 0, 8)),
+    ])
+    migrate_store(d)
+    image = recover_image(cfg, d, use_native=False)
+    assert int(image.log_end[0]) == 8
+    assert int(image.offsets[1, 0]) == 8
