@@ -1290,6 +1290,123 @@ def _run_group_consume(n_groups: int = 3, members: int = 2,
         }
 
 
+def _run_stripe_encode(mb: int = 4, reps: int = 3) -> float:
+    """stripe_encode_mb_per_sec: GF(2⁸) RS(3,2) group-encode throughput
+    at the sender's group-commit blob shape (one gf_matmul per blob —
+    the Pallas kernel on TPU, the bit-linear XLA fallback elsewhere).
+    Best-of-N over a fixed ~`mb` MB record batch; the first call pays
+    the per-size-class compile and is excluded."""
+    from ripplemq_tpu.stripes.codec import encode_group
+
+    records = [(1, 0, i, bytes(64 << 10)) for i in range(mb * 16)]
+    nbytes = sum(len(r[3]) for r in records)
+    encode_group(records, 1, 0)  # compile the size class
+    best = 0.0
+    for r in range(1, reps + 1):
+        t0 = time.perf_counter()
+        encode_group(records, 1, r)
+        dt = time.perf_counter() - t0
+        best = max(best, nbytes / dt / 1e6)
+    return round(best, 2)
+
+
+def _run_repl_bytes(n_batches: int = 40, batch: int = 8,
+                    payload_bytes: int = 400) -> dict:
+    """Measured replication bytes per acked payload byte in BOTH
+    replication modes, on a 5-broker in-proc cluster (controller + 4
+    standbys — the R=5-equivalent durability shape the striping math
+    targets: full-copy ships (R-1)=4 copies, striping (k+m)/k ≈ 1.67).
+
+    Bytes are the modes' own acked-stream counters (`repl.bytes` /
+    `stripes.bytes`: payload/frame bytes of standby-acked replication
+    RPCs); acked bytes are counted client-side. Both numerators carry
+    the same real overheads — slot padding to slot_bytes, REC_PIDSEQ /
+    REC_OFFSETS records, stripe frame headers — so the ratio is the
+    honest hot-path lever, not a geometry identity."""
+    import tempfile
+    import shutil
+
+    from ripplemq_tpu.chaos.cluster import (
+        InProcCluster,
+        make_cluster_config,
+        small_engine,
+    )
+    from ripplemq_tpu.client import ProducerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    out: dict = {}
+    for mode in ("full", "striped"):
+        tmp = tempfile.mkdtemp(prefix=f"replbytes-{mode}-")
+        config = make_cluster_config(
+            n_brokers=5, topics=(Topic("rb", 1, 3),),
+            engine=small_engine(1, 3, slots=1024, slot_bytes=512,
+                                max_batch=16),
+            replication=mode, standby_count=4,
+        )
+        cluster = InProcCluster(config, data_dir=tmp)
+        counters = {}
+        try:
+            cluster.start()
+            cluster.wait_for_leaders()
+            deadline = time.time() + 60
+            ctrl = None
+            while time.time() < deadline:
+                st = cluster.client("rb").call(
+                    cluster.broker_addr(0), {"type": "admin.stats"},
+                    timeout=5.0,
+                )
+                if len(st["controller"]["standbys"]) >= 4:
+                    ctrl = st["controller"]["id"]
+                    break
+                time.sleep(0.1)
+            assert ctrl is not None, "standby set never reached 4"
+            prod = ProducerClient(
+                [b.address for b in config.brokers],
+                transport=cluster.client("rb-prod"),
+                metadata_refresh_s=0.5,
+            )
+            acked = 0
+            for i in range(n_batches):
+                prod.produce_batch(
+                    "rb", [bytes([i & 0xFF]) * payload_bytes] * batch,
+                    partition=0,
+                )
+                acked += batch * payload_bytes
+            prod.close()
+            # Let the in-flight tail (striped mode's remaining m
+            # stripes stream past the k-ack settle) drain: poll the
+            # counters until they stop moving.
+            last = -1
+            for _ in range(50):
+                m = cluster.client("rb-m").call(
+                    cluster.broker_addr(ctrl), {"type": "admin.metrics"},
+                    timeout=5.0,
+                )
+                counters = m["metrics"]["counters"]
+                total = (counters.get("repl.bytes", 0)
+                         + counters.get("stripes.bytes", 0))
+                if total == last:
+                    break
+                last = total
+                time.sleep(0.2)
+        finally:
+            cluster.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+        repl_bytes = (counters.get("repl.bytes", 0)
+                      + counters.get("stripes.bytes", 0))
+        out[mode] = {
+            "repl_bytes": int(repl_bytes),
+            "acked_payload_bytes": int(acked),
+            "per_acked_byte": round(repl_bytes / max(1, acked), 3),
+            "stripe_groups": int(counters.get("stripes.groups", 0)),
+        }
+    out["striped_vs_full"] = round(
+        out["striped"]["per_acked_byte"] / out["full"]["per_acked_byte"],
+        3,
+    )
+    return out
+
+
 def _run_codec(batch: int = 256, payload_bytes: int = 100,
                iters: int = 400) -> dict:
     """Codec throughput on the produce-frame shape (the host-path codec
@@ -1439,6 +1556,11 @@ def main() -> None:
                                control_launches=ab_launches,
                                windows=2)
     codec_stats = _run_codec()
+    # ISSUE 9: the striped replication plane's byte accounting (full vs
+    # striped replication bytes per acked byte at the 4-standby shape)
+    # and the GF(2⁸) group-encode throughput.
+    repl_bytes = _run_repl_bytes()
+    stripe_encode = _run_stripe_encode()
     # ISSUE 7: multi-group drain through the consumer-group coordinator
     # (count-exact per group, shared offsets, generation fencing live).
     group_consume = _run_group_consume()
@@ -1468,6 +1590,8 @@ def main() -> None:
                 "control_fusion_ab": fusion_ab,
                 "codec_mb_per_sec": codec_stats["codec_mb_per_sec"],
                 "codec_ab": codec_stats,
+                "repl_bytes_per_acked_byte": repl_bytes,
+                "stripe_encode_mb_per_sec": stripe_encode,
                 "readback": "verified",
                 **group_consume,
                 **e2e,

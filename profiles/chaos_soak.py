@@ -58,6 +58,14 @@ def main() -> int:
                          "dual ownership, acked commits survive "
                          "rebalance, stale commits fenced, bounded "
                          "post-storm convergence)")
+    ap.add_argument("--replication", choices=["full", "striped"],
+                    default="full",
+                    help="'striped' runs the cluster with Reed–Solomon "
+                         "striped replication (stripes/) and joins the "
+                         "STRIPE-HOLDER ops to the nemesis pool "
+                         "(stripe_kill / stripe_partition, sized to m); "
+                         "the checker holds the run to the k-of-k+m "
+                         "durability contract")
     ap.add_argument("--timeline", action="store_true",
                     help="attach the merged fault-vs-lifecycle timeline "
                          "(nemesis fault ops + every broker's flight-"
@@ -93,12 +101,14 @@ def main() -> int:
             # replaying a proc trace (SIGKILL + disk ops) on the in-proc
             # backend would silently change what is being reproduced.
             args.backend = doc["backend"]
+        if isinstance(doc, dict) and doc.get("replication"):
+            args.replication = doc["replication"]  # same rationale
         n_phases = 1 + max((t.get("phase", 0) for t in trace), default=0)
         schedule = [[] for _ in range(n_phases)]
         for t in trace:
             op = {k: v for k, v in t.items() if k != "phase"}
             # restarts/heals are emitted by the nemesis itself.
-            if op.get("op") not in ("restart", "heal"):
+            if op.get("op") not in ("restart", "restart_holder", "heal"):
                 schedule[t.get("phase", 0)].append(op)
 
     seeds = list(range(args.sweep)) if args.sweep else [args.seed]
@@ -114,6 +124,7 @@ def main() -> int:
             schedule=schedule,
             backend=args.backend,
             groups=args.groups,
+            replication_mode=args.replication,
             include_timeline=args.timeline,
             include_postmortems=args.postmortems,
             # Process boots (JAX import + XLA compiles per broker) put

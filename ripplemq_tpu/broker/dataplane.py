@@ -927,6 +927,44 @@ class DataPlane:
         with self._lock:
             return len(self._pid_tab)
 
+    def drop_pids(self, pids: set[int]) -> int:
+        """Drop the dedup entries of REAPED producer ids (pid expiry,
+        OP_RETIRE_PRODUCER): settled-window entries go; in-flight
+        entries stay — they belong to LIVE submissions whose futures
+        settle through the normal path, and a reaped-mid-flight batch
+        keeps its wire-dup protection until it lands. Safe because
+        reaped pids are never reissued (the replicated counter is
+        monotone), so no new producer can collide with a dropped key.
+        Returns how many table keys were dropped."""
+        if not pids:
+            return 0
+        with self._lock:
+            drop = [k for k in self._pid_tab if k[0] in pids]
+            for k in drop:
+                del self._pid_tab[k]
+        return len(drop)
+
+    def retain_pids(self, keep: set[int], below: Optional[int] = None
+                    ) -> int:
+        """Reconciliation sweep: drop dedup entries whose pid is NOT in
+        `keep` (the replicated registry) — boot replay rebuilds
+        REC_PIDSEQ entries for pids reaped while this broker was down,
+        and those would otherwise linger forever. `below` is the
+        locally-applied pid counter: a pid >= below belongs to a
+        registration THIS replica has not applied yet (the pid space
+        is the replicated monotone counter), so its absence from
+        `keep` is apply lag, not a reap — never drop it. Returns
+        drops."""
+        with self._lock:
+            drop = [
+                k for k in self._pid_tab
+                if k[0] not in keep
+                and (below is None or k[0] < below)
+            ]
+            for k in drop:
+                del self._pid_tab[k]
+        return len(drop)
+
     def submit_offsets(self, slot: int, updates: list[tuple[int, int]]) -> Future:
         """Queue consumer-offset commits [(consumer_slot, offset)]; the
         future resolves to True when the round commits (offset commits

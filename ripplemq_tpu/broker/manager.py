@@ -48,6 +48,7 @@ from ripplemq_tpu.metadata.models import (
     topics_from_wire,
     topics_to_wire,
 )
+from ripplemq_tpu.stripes.codec import stripe_assignment
 
 class ConsumerTableFullError(Exception):
     """All `max_consumers` device-table slots are bound to names. The
@@ -66,6 +67,15 @@ OP_REGISTER_CONSUMER = "register_consumer"
 # every process lifetime, or two producers' sequence spaces collide in
 # the broker's dedup table).
 OP_REGISTER_PRODUCER = "register_producer"
+# Producer-id expiry (the PR 7 grow-forever residual): the metadata
+# leader reaps a pid idle past pid_retention_s. Registration is also
+# the SESSION REFRESH — re-registering an existing name bumps its
+# replicated `seen` counter — and the reap command carries the counter
+# value the leader observed, so the apply re-checks idleness and a
+# racing refresh/produce-driven re-register always wins. Reaped pids
+# are never reissued (next_pid is monotone); the attached dataplane
+# drops the pid's dedup entries in the same apply.
+OP_RETIRE_PRODUCER = "retire_producer"
 # Consumer-slot recycling: release frees a name→slot binding but parks
 # the slot as DIRTY (its device offset row still holds the old
 # consumer's positions); the controller resets the row through ordinary
@@ -135,6 +145,11 @@ class PartitionManager:
         # pid counter (pid 0 is reserved = "no pid").
         self.producers: dict[str, int] = {}
         self.next_pid = 1
+        # Replicated session-refresh counter per producer name: bumped
+        # by every (re-)registration; the reaper's OP_RETIRE_PRODUCER
+        # names the value it observed and the apply drops the pid only
+        # if it still matches (idleness re-checked at apply time).
+        self.producer_seen: dict[str, int] = {}
         # Consumer groups: replicated membership/generation/assignment.
         self.groups = GroupTable()
         # Optional flight recorder (the owning BrokerServer's): group
@@ -148,6 +163,13 @@ class PartitionManager:
         self.controller_broker: int = config.controller
         self.controller_epoch: int = 0
         self.standbys: tuple[int, ...] = ()
+        # Stripe→member assignment (replication="striped"): derived
+        # deterministically from the standby set inside every standby-
+        # set apply and recorded beside it, so "who holds stripe i" is
+        # replicated metadata promotion can consult (stripes/codec.
+        # stripe_assignment; recovery still asks every live broker, so
+        # the map is routing truth, not a safety dependency).
+        self.stripe_holders: tuple[int, ...] = ()
         # Election debounce: slot → when it was first seen leaderless.
         # A partition must stay leaderless for config.election_timeout_s
         # before the controller ballots it (the role JRaft's per-group
@@ -185,6 +207,10 @@ class PartitionManager:
             self._apply_register_consumer(str(cmd["consumer"]), int(cmd["slot"]))
         elif op == OP_REGISTER_PRODUCER:
             self._apply_register_producer(str(cmd["producer"]))
+        elif op == OP_RETIRE_PRODUCER:
+            self._apply_retire_producer(
+                str(cmd["producer"]), int(cmd["seen"])
+            )
         elif op == OP_RELEASE_CONSUMER:
             self._apply_release_consumer(str(cmd["consumer"]))
         elif op == OP_CONSUMER_SLOT_CLEAN:
@@ -221,11 +247,13 @@ class PartitionManager:
                 "consumers": dict(self.consumers),
                 "dirty_consumer_slots": sorted(self.dirty_consumer_slots),
                 "producers": dict(self.producers),
+                "producer_seen": dict(self.producer_seen),
                 "next_pid": self.next_pid,
                 "groups": self.groups.to_wire(),
                 "controller": self.controller_broker,
                 "controller_epoch": self.controller_epoch,
                 "standbys": list(self.standbys),
+                "stripe_holders": list(self.stripe_holders),
             }
 
     def restore(self, state: dict) -> None:
@@ -240,6 +268,10 @@ class PartitionManager:
             self.producers = {
                 str(k): int(v) for k, v in state.get("producers", {}).items()
             }
+            self.producer_seen = {
+                str(k): int(v)
+                for k, v in state.get("producer_seen", {}).items()
+            }
             self.next_pid = int(state.get("next_pid", 1))
             self.groups = GroupTable.from_wire(state.get("groups", {}))
             # Controller fields default to bootstrap values for snapshots
@@ -249,6 +281,11 @@ class PartitionManager:
             )
             self.controller_epoch = int(state.get("controller_epoch", 0))
             self.standbys = tuple(int(b) for b in state.get("standbys", ()))
+            self.stripe_holders = tuple(
+                int(b) for b in state.get(
+                    "stripe_holders", stripe_assignment(self.standbys)
+                )
+            )
             self._apply_set_topics(
                 topics_from_wire(state["topics"]),
                 [int(b) for b in state["live"]],
@@ -264,6 +301,7 @@ class PartitionManager:
         self.controller_broker = controller
         self.controller_epoch = epoch
         self.standbys = tuple(b for b in standbys if b != controller)
+        self.stripe_holders = stripe_assignment(self.standbys)
 
     def _apply_set_standbys(self, epoch: int, standbys: list[int]) -> None:
         """Standby-set rewrite, valid only within the current epoch."""
@@ -272,6 +310,7 @@ class PartitionManager:
         self.standbys = tuple(
             b for b in standbys if b != self.controller_broker
         )
+        self.stripe_holders = stripe_assignment(self.standbys)
 
     def _apply_register_consumer(self, name: str, slot: int) -> None:
         """Idempotent consumer registration. The proposed slot was chosen
@@ -294,11 +333,30 @@ class PartitionManager:
         """Issue one pid per producer name (idempotent — the client's
         registration proposal may be retried/duplicated). The counter is
         replicated state: a pid is unique across brokers AND process
-        lifetimes, which is what makes it a safe dedup-table key."""
+        lifetimes, which is what makes it a safe dedup-table key.
+        Re-registering an EXISTING name is the session refresh: it
+        bumps the replicated seen counter the reaper's idleness check
+        keys on (see OP_RETIRE_PRODUCER)."""
+        self.producer_seen[name] = self.producer_seen.get(name, 0) + 1
         if name in self.producers:
             return
         self.producers[name] = self.next_pid
         self.next_pid += 1
+
+    def _apply_retire_producer(self, name: str, seen: int) -> None:
+        """Reap one idle pid — ONLY if its seen counter still equals
+        what the proposing leader observed: a registration refresh (or
+        a fresh client re-registering the name) racing the reap bumps
+        the counter and the reap no-ops, so an active producer never
+        loses its dedup window to a stale idleness observation."""
+        if self.producer_seen.get(name, 0) != seen:
+            return
+        pid = self.producers.pop(name, None)
+        self.producer_seen.pop(name, None)
+        if pid is not None and self.dataplane is not None:
+            # The controller's dedup table drops the reaped pid's
+            # entries in the same apply (other brokers have no table).
+            self.dataplane.drop_pids({pid})
 
     def _apply_release_consumer(self, name: str) -> None:
         """Free a consumer-name binding (group dissolution, member
@@ -563,6 +621,20 @@ class PartitionManager:
         with self.lock:
             return self.standbys
 
+    def current_stripe_map(self) -> tuple[int, ...]:
+        """The replicated stripe→member assignment (empty when no
+        standby ever joined, or in replication='full' deployments —
+        the map is derived from the standby set either way)."""
+        with self.lock:
+            return self.stripe_holders
+
+    def live_brokers(self) -> list[int]:
+        """The replicated liveness view (locked copy) — the striped
+        plane's below-k refusal keys on holders that are both set
+        members AND live."""
+        with self.lock:
+            return list(self.live)
+
     def get_topics(self) -> list[Topic]:
         with self.lock:
             return list(self.topics)
@@ -612,6 +684,26 @@ class PartitionManager:
         registration op applies locally)."""
         with self.lock:
             return self.producers.get(name)
+
+    def producer_sessions(self) -> dict[str, tuple[int, int]]:
+        """name → (pid, seen counter), a locked copy — the reaper
+        duty's working set (BrokerServer._pid_reap_duty)."""
+        with self.lock:
+            return {
+                n: (pid, self.producer_seen.get(n, 0))
+                for n, pid in self.producers.items()
+            }
+
+    def registered_pids(self) -> tuple[set[int], int]:
+        """(currently-registered pids, locally-applied pid counter) —
+        the dedup-table reconciliation set plus its VALIDITY FLOOR: a
+        pid at-or-above the local next_pid was issued by a registration
+        this replica has not applied yet, so its absence from the
+        registry proves nothing and the reconciler must not drop its
+        entries (a freshly registered producer can settle batches on
+        the controller before the controller's own apply catches up)."""
+        with self.lock:
+            return set(self.producers.values()), self.next_pid
 
     def group_state(self, group: str):
         """A WIRE-COPY of one group's replicated state (GroupState), or

@@ -273,6 +273,9 @@ class _Sender(threading.Thread):
                         )
                         self._rep._c_records.inc(len(records))
                         self._rep._c_frames.inc()
+                        self._rep._c_bytes.inc(
+                            sum(len(r[3]) for r in records)
+                        )
                     log.debug("standby %d acked %d records (%d rounds) at "
                               "epoch %d", self.broker_id, len(records),
                               len(futs), epoch)
@@ -331,11 +334,18 @@ class RoundReplicator:
             self._h_frame_us = metrics.histogram("repl.frame_us")
             self._c_records = metrics.counter("repl.records")
             self._c_frames = metrics.counter("repl.frames")
+            # Replication payload bytes ACKED across all standby
+            # streams — the numerator of the bench's
+            # repl_bytes_per_acked_byte accounting (full-copy mode
+            # counts every member's copy; the striped twin counts
+            # stripe frame bytes under stripes.bytes).
+            self._c_bytes = metrics.counter("repl.bytes")
             self._c_retries = metrics.counter("repl.send_retries")
             self._clock = metrics.clock
         else:
             self._h_group = self._h_frame_us = None
             self._c_records = self._c_frames = self._c_retries = None
+            self._c_bytes = None
             self._clock = time.perf_counter
         self._lock = threading.Lock()
         self._senders: dict[int, _Sender] = {}

@@ -53,6 +53,7 @@ from ripplemq_tpu.broker.manager import (
     OP_GROUP_LEAVE,
     OP_REGISTER_CONSUMER,
     OP_REGISTER_PRODUCER,
+    OP_RETIRE_PRODUCER,
     OP_SET_STANDBYS,
     ConsumerTableFullError,
     PartitionManager,
@@ -229,6 +230,9 @@ class BrokerServer:
         self._shard_push_seeded = False
         self._last_shard_push = 0.0
         self._store_quarantined = False
+        # How many striped-promotion rebuilds this process ran
+        # (admin.stats `stripe_rebuilds`; stripes/recovery.py).
+        self._stripe_rebuilds = 0
         # Since the last quarantine, has this broker been observed OUT of
         # the replicated standby set? A broker that died IN the set boots
         # with stale membership still naming it — which proves nothing
@@ -288,6 +292,16 @@ class BrokerServer:
         # rule as member sessions).
         self._group_liveness = GroupLiveness()
         self._group_empty_since: dict[str, float] = {}
+        # Producer-id expiry (metadata-leader duty): volatile ledger
+        # name → (seen counter, first observed at) — the same per-
+        # tenure grace rule as group liveness: cleared on losing the
+        # lease, so a re-elected leader grants every pid a full
+        # retention window instead of reaping off a previous tenure's
+        # stamps. The replicated half is the seen counter itself
+        # (bumped by every re-registration; the reap apply re-checks
+        # it, manager._apply_retire_producer).
+        self._pid_seen_at: dict[str, tuple[int, float]] = {}
+        self._last_pid_reconcile = 0.0
         # Broker-stamped idempotence for pid-LESS produces: the leader
         # stamps each forwarded batch with its own metadata-issued pid +
         # a per-slot sequence, so a duplicated leader→controller
@@ -303,6 +317,7 @@ class BrokerServer:
             f"_broker/{broker_id}/{_uuid.uuid4().hex[:12]}"
         )
         self._broker_pid_proposed = 0.0
+        self._broker_pid_refreshed = 0.0
         self._stamp_lock = threading.Lock()
         self._stamp_seqs: dict[int, int] = {}
         persist_fn = None
@@ -444,6 +459,16 @@ class BrokerServer:
                 # applied, the repl.rounds fence refuses the stale
                 # stream, so nothing new lands mid-scan.
                 self._round_store.flush()
+                # Striped replication: a PROMOTED standby's store holds
+                # stripe frames, not full rows — rebuild the committed
+                # record stream from any k surviving stripes (local +
+                # peers) and REWRITE the store to full records before
+                # replay, so the booted controller serves reads below
+                # trim and can catch up fresh standbys exactly like a
+                # full-copy one (stripes/recovery.py; a short-of-k
+                # non-tail group quarantines via CorruptStoreError, a
+                # peers-unreachable shortfall retries the boot).
+                self._rebuild_store_from_stripes()
                 # Coverage holes in the recovered stream are rounds the
                 # writing controller nacked (committed on device, never
                 # settled): re-register them as settled gaps so the
@@ -553,11 +578,13 @@ class BrokerServer:
         dp.replicate_wait_fn = rep.wait
 
     def _make_replicator(self):
-        from ripplemq_tpu.broker.replication import RoundReplicator
-
-        self._replicator = RoundReplicator(
-            self.client,
-            self._addr_of,
+        """Replication-plane factory: `replication="full"` streams full
+        copies to every standby (RoundReplicator); `"striped"` encodes
+        each group commit into k+m RS stripes shipped to distinct
+        standbys and settles at any k stripe-acks (StripeReplicator —
+        same begin/wait/catchup/suspects surface, (k+m)/k× the bytes
+        instead of standby_count×)."""
+        kw = dict(
             epoch_fn=self.manager.current_epoch,
             members_fn=self.manager.current_standbys,
             active_fn=lambda: (
@@ -567,6 +594,21 @@ class BrokerServer:
             ack_timeout_s=self.config.rpc_timeout_s,
             metrics=self.metrics,
         )
+        if self.config.replication == "striped":
+            from ripplemq_tpu.stripes.plane import StripeReplicator
+
+            self._replicator = StripeReplicator(
+                self.client, self._addr_of,
+                stripe_map_fn=self.manager.current_stripe_map,
+                live_fn=self.manager.live_brokers,
+                **kw,
+            )
+        else:
+            from ripplemq_tpu.broker.replication import RoundReplicator
+
+            self._replicator = RoundReplicator(
+                self.client, self._addr_of, **kw,
+            )
         return self._replicator
 
     def _local_engine(self) -> Optional[DataPlane]:
@@ -660,6 +702,10 @@ class BrokerServer:
                 return self._handle_group(t, req)
             if t == "repl.rounds":
                 return self._handle_repl_rounds(req)
+            if t == "repl.stripes":
+                return self._handle_repl_stripes(req)
+            if t == "stripe.fetch":
+                return self._handle_stripe_fetch(req)
             if t == "admin.stats":
                 return self._handle_stats(req)
             if t == "admin.metrics":
@@ -777,6 +823,16 @@ class BrokerServer:
             "erasure_errors": list(
                 getattr(self._round_store, "erasure_errors", [])
             ),
+            # Striped replication surface: the active replication plane,
+            # the replicated stripe→member assignment (stripe i held by
+            # stripe_holders[i]; empty before a standby joins or in
+            # full-copy mode), and how many any-k promotion rebuilds
+            # this process has run (stripes/recovery.py).
+            "stripe_mode": self.config.replication,
+            "stripe_holders": [
+                int(b) for b in self.manager.current_stripe_map()
+            ],
+            "stripe_rebuilds": self._stripe_rebuilds,
         }
         dp = self._local_engine()
         if dp is None:
@@ -970,6 +1026,105 @@ class BrokerServer:
             "quarantined to %s — reopening empty, will re-replicate via "
             "standby catch-up", self.broker_id, type(cause).__name__,
             cause, target,
+        )
+
+    def _rebuild_store_from_stripes(self) -> None:
+        """Striped-promotion rebuild: if the local store holds
+        REC_STRIPE frames (this broker lived as a striped standby),
+        gather the missing stripe indices from live peers
+        (stripe.fetch), reconstruct every group's records from any k
+        of its k+m stripes, and REWRITE the store as a plain full-
+        record store (previous bytes kept at `segments.prestripe-N`
+        for forensics). No-op when the store has no stripes (ordinary
+        controller restart, full-copy mode, genesis).
+
+        Failure ladder (rebuild-or-quarantine, PR 4): a group short of
+        k with some peer unreachable raises StripeRecoveryError — the
+        takeover duty retries next tick and repeated failures abdicate;
+        short of k with EVERY peer consulted raises CorruptStoreError,
+        routing into the existing quarantine machinery (non-tail only
+        — a torn tail of never-settled groups is dropped)."""
+        from ripplemq_tpu.storage.segment import (
+            REC_STRIPE,
+            CorruptStoreError,
+            SegmentStore,
+        )
+        from ripplemq_tpu.stripes.recovery import (
+            StripeDataLossError,
+            rebuild_records,
+        )
+
+        store = self._round_store
+        if store is None:
+            return
+        if not any(rec[0] == REC_STRIPE for rec in store.scan()):
+            return
+        self._stripe_rebuilds += 1
+        self.recorder.record("stripe_rebuild",
+                             epoch=self.manager.current_epoch())
+
+        def mk_fetch(addr):
+            def fetch(after):
+                resp = self.client.call(
+                    addr, {"type": "stripe.fetch", "after": after},
+                    timeout=min(10.0, 2 * self.config.rpc_timeout_s),
+                )
+                if not resp.get("ok"):
+                    raise RpcError(
+                        f"stripe.fetch refused: {resp.get('error')}"
+                    )
+                return resp.get("frames", []), resp.get("next")
+            return fetch
+
+        fetchers = [
+            (b.address, mk_fetch(b.address))
+            for b in self.config.brokers
+            if b.broker_id != self.broker_id
+        ]
+        try:
+            records = rebuild_records(store.scan(), fetchers,
+                                      platform="cpu")
+        except StripeDataLossError as e:
+            raise CorruptStoreError(f"stripe rebuild: {e}") from e
+        log.info(
+            "broker %d: rebuilt %d full records from stripe store "
+            "(rebuild #%d)", self.broker_id, len(records),
+            self._stripe_rebuilds,
+        )
+        if self._store_dir is None:
+            # In-memory store (in-proc cluster without a data dir):
+            # rewrite in place.
+            from ripplemq_tpu.storage.memstore import MemoryRoundStore
+
+            fresh = MemoryRoundStore()
+            for rec in records:
+                fresh.append(*rec)
+            self._round_store = fresh
+            return
+        import os
+
+        tmp = self._store_dir + ".restripe"
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp)
+        out = SegmentStore(tmp, segment_bytes=self.config.segment_bytes)
+        try:
+            for i in range(0, len(records), 256):
+                out.append_many(records[i : i + 256])
+        finally:
+            out.close()
+        store.close()
+        n = 0
+        while os.path.exists(f"{self._store_dir}.prestripe-{n}"):
+            n += 1
+        os.replace(self._store_dir, f"{self._store_dir}.prestripe-{n}")
+        os.replace(tmp, self._store_dir)
+        self._round_store = SegmentStore(
+            self._store_dir, erasure=True,
+            segment_bytes=self.config.segment_bytes,
+            retention_bytes=self.config.store_retention_bytes,
+            metrics=self.metrics,
         )
 
     def _refill_shards_from_peers(self) -> None:
@@ -1658,19 +1813,97 @@ class BrokerServer:
         name embeds a per-boot nonce, so a restarted broker gets a FRESH
         pid — its in-memory sequence counters restart at zero, and
         reusing the old pid would collide with the table the cluster
-        still holds for it."""
-        if self._broker_pid is not None:
-            return
-        if self.manager.producer_id(self._broker_pid_name) is not None:
-            return  # applied; the next stamp picks it up
+        still holds for it. A registered pid then RE-REGISTERS at a
+        third of pid_retention_s: the registration apply bumps the
+        replicated seen counter, which is the session refresh the
+        pid reaper keys on — a live broker's stamping pid never
+        expires."""
         now = time.monotonic()
+        cur = self.manager.producer_id(self._broker_pid_name)
+        if cur is not None and cur != self._broker_pid:
+            # ADOPT whatever pid the registry holds for our name: if the
+            # old pid was reaped while this broker was partitioned past
+            # the retention window, the refresh below re-registered the
+            # name under a FRESH pid — stamping must move to it, or
+            # every stamp would ride a reaped pid whose dedup entries
+            # the reconciler deletes each tick (a silent duplicate
+            # window on the forwarded hop). Sequence counters carry
+            # over safely: the fresh pid's table is empty, so every
+            # current counter value is above its settled end.
+            self._broker_pid = cur
+        if cur is not None:
+            retention = self.config.pid_retention_s
+            if retention <= 0:
+                return
+            if now - self._broker_pid_refreshed < max(1.0, retention / 3):
+                return
+            self._broker_pid_refreshed = now
+            self.propose_cmd(
+                {"op": OP_REGISTER_PRODUCER,
+                 "producer": self._broker_pid_name},
+                retries=1,
+            )
+            return
         if now - self._broker_pid_proposed < 1.0:
             return
         self._broker_pid_proposed = now
+        self._broker_pid_refreshed = now
         self.propose_cmd(
             {"op": OP_REGISTER_PRODUCER, "producer": self._broker_pid_name},
             retries=1,
         )
+
+    def _pid_reap_duty(self) -> None:
+        """Producer-id expiry (the PR 7 grow-forever residual closed):
+        pids get sessions like groups got. The metadata LEADER stamps
+        each pid's replicated seen counter into a volatile per-tenure
+        ledger; a pid whose counter has not moved for pid_retention_s
+        is reaped via OP_RETIRE_PRODUCER — whose apply re-checks the
+        counter, so a racing re-registration (ProducerClient refreshes
+        at pid_refresh_s; the broker stamping pid at retention/3)
+        always wins. The CONTROLLER side reconciles its dedup table
+        against the registry on the same cadence: boot replay rebuilds
+        REC_PIDSEQ entries for pids reaped while it was down, and
+        those must not linger (admin.stats `pid_table_size` stops
+        growing monotonically under client churn — the directed test's
+        assertion)."""
+        retention = self.config.pid_retention_s
+        if retention <= 0:
+            return
+        now = time.monotonic()
+        # Controller-side reconciliation (any broker with the plane).
+        dp = self._local_engine()
+        if dp is not None and now - self._last_pid_reconcile >= max(
+            1.0, min(5.0, retention / 4)
+        ):
+            self._last_pid_reconcile = now
+            keep, next_pid = self.manager.registered_pids()
+            dp.retain_pids(keep | {0}, below=next_pid)
+        node = self.runner.node
+        if node.role != LEADER:
+            # Stamps from a previous tenure are stale the moment the
+            # lease moves (the group-liveness rule): clear, so a fresh
+            # leader grants every pid a full retention window.
+            self._pid_seen_at.clear()
+            return
+        sessions = self.manager.producer_sessions()
+        for name in list(self._pid_seen_at):
+            if name not in sessions:
+                del self._pid_seen_at[name]
+        for name, (pid, seen) in sessions.items():
+            prev = self._pid_seen_at.get(name)
+            if prev is None or prev[0] != seen:
+                self._pid_seen_at[name] = (seen, now)
+                continue
+            if now - prev[1] > retention:
+                self._pid_seen_at.pop(name, None)
+                log.info("broker %d: reaping idle producer id %d (%s)",
+                         self.broker_id, pid, name)
+                self.propose_cmd(
+                    {"op": OP_RETIRE_PRODUCER, "producer": name,
+                     "seen": seen},
+                    retries=1,
+                )
 
     def _engine_append(self, slot: int, messages: list[bytes],
                        pid: int = 0, seq: int = -1) -> Callable[[], int]:
@@ -1893,6 +2126,135 @@ class BrokerServer:
             self._repl_last_flush = now
         return {"ok": True}
 
+    def _handle_repl_stripes(self, req: dict) -> dict:
+        """Standby side of STRIPED replication (stripes/plane.py): the
+        repl.rounds fences verbatim, then each frame is CRC-validated
+        and persisted as a REC_STRIPE record — a frame damaged in
+        flight REFUSES (`bad_stripe_frame`; the sender re-sends from
+        its in-memory copy), never lands, so the store only ever holds
+        frames the recovery path can trust byte-for-byte."""
+        from ripplemq_tpu.storage.segment import REC_STRIPE
+        from ripplemq_tpu.stripes.codec import parse_frame
+
+        epoch = int(req["epoch"])
+        cur = self.manager.current_epoch()
+        if epoch < cur:
+            return {"ok": False, "error": "stale_epoch", "epoch": cur}
+        if (
+            self.dataplane is not None
+            and self.manager.current_controller() == self.broker_id
+        ):
+            return {"ok": False, "error": "active_controller"}
+        if self._store_quarantined and not self._quarantine_left_set:
+            # Same stale-membership fence as repl.rounds: an emptied
+            # store must not ack stripes under pre-death membership.
+            return {"ok": False, "error": "store_quarantined"}
+        store = self._round_store
+        if store is None:
+            return {"ok": False, "error": "no_store"}
+        recs = []
+        for raw in req["frames"]:
+            raw = bytes(raw)
+            frame = parse_frame(raw)
+            if frame is None:
+                return {"ok": False, "error": "bad_stripe_frame"}
+            recs.append(
+                (REC_STRIPE, frame.idx, int(frame.gsn) & 0x7FFFFFFF, raw)
+            )
+        append_many = getattr(store, "append_many", None)
+        if append_many is not None:
+            append_many(recs)
+        else:
+            for rec in recs:
+                store.append(*rec)
+        if self.config.durability == "strict":
+            store.flush()
+            return {"ok": True}
+        now = time.monotonic()
+        if now - self._repl_last_flush >= 0.05:
+            flush = getattr(store, "flush_async", store.flush)
+            flush()
+            self._repl_last_flush = now
+        return {"ok": True}
+
+    def _handle_stripe_fetch(self, req: dict) -> dict:
+        """Serve this broker's persisted stripe frames to a PROMOTED
+        peer rebuilding the full stream (stripes/recovery.py): paged
+        scan of REC_STRIPE records, cursor = ordinal among them. Served
+        by any broker with a store, unfenced — recovery runs exactly
+        when controllership is in flux."""
+        from ripplemq_tpu.storage.segment import REC_STRIPE
+
+        store = self._round_store
+        if store is None:
+            return {"ok": False, "error": "no_store"}
+
+        def stripe_records():
+            # The LIVE store first, then any `.prestripe-N` snapshots a
+            # previous promotion of THIS broker preserved: the rebuild
+            # rewrites the store to full records, and without serving
+            # the preserved stripes a later promotion elsewhere could
+            # find the cluster short of k (observed in the first smoke
+            # as an unrecoverable-group boot loop). Yields (cursor,
+            # payload) where cursor = [phase, segment, offset] — a
+            # STABLE position (segments GC whole; surviving locators
+            # never shift), unlike a flat ordinal, which retention trim
+            # between two pages would slide under the requester,
+            # silently skipping frames. A store without stable locators
+            # (MemoryRoundStore) never GCs, so its record ordinal is
+            # stable too.
+            if hasattr(store, "scan_indexed"):
+                it = store.scan_indexed()
+            else:
+                it = ((t, s, b, p, i) for i, (t, s, b, p)
+                      in enumerate(store.scan()))
+            for j, (t, _s, _b, payload, loc) in enumerate(it):
+                if t != REC_STRIPE:
+                    continue
+                if isinstance(loc, tuple):
+                    yield [0, int(loc[0]), int(loc[1])], payload
+                else:
+                    yield [0, 0, j], payload
+            if self._store_dir is not None:
+                import glob as _glob
+                import os as _os
+
+                from ripplemq_tpu.storage.segment import scan_store_indexed
+
+                def _n(p):
+                    try:
+                        return int(p.rsplit("-", 1)[1])
+                    except ValueError:
+                        return 1 << 30
+                dirs = sorted(
+                    _glob.glob(self._store_dir + ".prestripe-*"), key=_n
+                )
+                for phase, d in enumerate(dirs, start=1):
+                    if not _os.path.isdir(d):
+                        continue
+                    try:
+                        for t, _s, _b, payload, loc in scan_store_indexed(d):
+                            if t == REC_STRIPE:
+                                yield [phase, int(loc[0]),
+                                       int(loc[1])], payload
+                    except Exception:
+                        continue  # forensic snapshot rot: best-effort
+
+        after = req.get("after", -1)
+        after = None if after in (-1, None) else list(after)
+        budget = 32 << 20
+        frames: list[bytes] = []
+        nxt = None
+        for cursor, payload in stripe_records():
+            if after is not None and cursor <= after:
+                continue
+            frames.append(payload)
+            budget -= len(payload)
+            if budget <= 0:
+                nxt = cursor
+                break
+        return {"ok": True, "frames": frames, "next": nxt}
+
     # ---------------------------------------------------------------- duty
 
     def _duty_loop(self) -> None:
@@ -1900,6 +2262,7 @@ class BrokerServer:
             try:
                 self._metadata_leader_duty()
                 self._producer_pid_duty()
+                self._pid_reap_duty()
                 self._group_duty()
                 self._abdicate_duty()
                 self._fence_duty()

@@ -140,6 +140,23 @@ class InProcCluster:
             return None
         return any_b.manager.leader_of((topic, pid))
 
+    def stripe_holders(self) -> tuple[int, ...]:
+        """The replicated stripe→member map as any live broker sees it
+        (empty before a standby joins / in full-copy mode) — the
+        nemesis's stripe-op resolution surface."""
+        for b in self.brokers.values():
+            if not b._stopped:
+                return tuple(b.manager.current_stripe_map())
+        return ()
+
+    def controller_id(self):
+        """Current controller broker id per any live broker's view
+        (None when every broker is down)."""
+        for b in self.brokers.values():
+            if not b._stopped:
+                return b.manager.current_controller()
+        return None
+
     def controller_ready(self) -> bool:
         """Controller known with >= 1 replication standby joined (the
         precondition chaos runs wait for before the first crash)."""

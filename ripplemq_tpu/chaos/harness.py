@@ -241,6 +241,7 @@ def run_chaos(
     include_postmortems: bool = False,
     include_timeline: bool = False,
     groups: int = 0,
+    replication_mode: str = "full",
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -250,6 +251,14 @@ def run_chaos(
     fake transport — network faults, fastest) or "proc" (real broker
     subprocesses over TCP — SIGKILL + disk-fault schedules against the
     deployment shape; chaos.proc_cluster). Verdict schema is identical.
+
+    `replication_mode="striped"` runs the cluster with Reed–Solomon striped
+    replication (ripplemq_tpu/stripes/) and joins the STRIPE-HOLDER ops
+    to the nemesis pool (stripe_kill / stripe_partition, sized to m per
+    phase) — disk faults then land in stripe stores by construction
+    (standby segments hold REC_STRIPE frames), and check_history holds
+    the run to the k-of-k+m contract (zero acked loss while any k
+    stripe-holders survive; see its `stripe` parameter).
 
     `groups > 0` adds a consumer-group workload of that many members
     (one group, drained through the real GroupConsumer SDK on either
@@ -289,6 +298,7 @@ def run_chaos(
             # Short member sessions so a paused member's eviction (and
             # the rebalance it forces) lands INSIDE a chaos phase.
             group_session_timeout_s=0.8,
+            replication=replication_mode,
         )
         cluster = ProcCluster(config=config, data_dir=data_dir)
     else:
@@ -305,17 +315,20 @@ def run_chaos(
             # opts IN, so every surviving violation is a real bug.
             linearizable_reads=True,
             group_session_timeout_s=0.8,  # see the proc branch above
+            replication=replication_mode,
         )
         cluster = InProcCluster(config, data_dir=data_dir)
     history = History()
     verdict: dict = {"seed": seed, "phases": phases,
-                     "ops_per_phase": ops_per_phase, "backend": backend}
+                     "ops_per_phase": ops_per_phase, "backend": backend,
+                     "replication": replication_mode}
     try:
         cluster.start()
         cluster.wait_for_leaders()
         nemesis = Nemesis(cluster, seed, phases,
                           ops_per_phase=ops_per_phase, schedule=schedule,
-                          backend=backend, group_members=groups)
+                          backend=backend, group_members=groups,
+                          striped=(replication_mode == "striped"))
         # Wait for one replication standby before the first crash:
         # settled appends are then provably on a promotable peer.
         deadline = time.time() + (120 if backend == "proc" else 20)
@@ -376,7 +389,22 @@ def run_chaos(
         # are collapsed by the idempotent-producer dedup plane (client
         # pids + broker stamping on the forwarded hop) — the PR 2
         # suspension branch is gone, on purpose.
-        violations = check_history(history.ops(), final_logs)
+        stripe_contract = None
+        if replication_mode == "striped":
+            from ripplemq_tpu.stripes.codec import RS_K, RS_M
+
+            stripe_contract = {
+                "k": RS_K, "m": RS_M,
+                "holders_down": nemesis.max_stripe_kills_per_phase,
+            }
+            if nemesis.max_stripe_kills_per_phase > RS_M:
+                # The loss check is about to be waived (hand-written or
+                # edited schedule beyond the k-of-k+m contract): say so
+                # in the verdict — a clean run with waived loss
+                # checking must never read as a clean run.
+                verdict["beyond_stripe_contract"] = True
+        violations = check_history(history.ops(), final_logs,
+                                   stripe=stripe_contract)
         if group_workload is not None:
             violations += check_group_history(history.ops())
             if not group_verdict.get("converged"):

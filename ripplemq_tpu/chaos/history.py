@@ -97,6 +97,7 @@ def _subsequence_gap(needle: list[str], hay: list[str]) -> Optional[str]:
 def check_history(ops: list[dict],
                   final_logs: dict[tuple[str, int], list[str]],
                   loss_grace: Optional[list[tuple[float, float]]] = None,
+                  stripe: Optional[dict] = None,
                   ) -> list[str]:
     """Return the list of invariant violations (empty = safe).
 
@@ -115,8 +116,26 @@ def check_history(ops: list[dict],
     bounded by one flush interval, and the kill-all drill passes the
     pre-kill window here. `durability=strict` deployments opt out of
     the lag entirely — the drill passes no window for them either.
+
+    `stripe`: the striped-replication k-of-k+m durability contract
+    ({"k": K, "m": M, "holders_down": N}, run_chaos's replication_mode=
+    "striped"). The plane claims ZERO acked loss while any k stripe-
+    holders survive — i.e. while at most m holders are lost at once —
+    so with holders_down <= m the no-loss check stays ABSOLUTE (the
+    generated schedules size stripe kills to m, keeping it absolute on
+    every seeded run). holders_down > m is the documented beyond-
+    contract regime (a hand-written schedule or a replay edit):
+    acked-loss findings are then SUPPRESSED from the violation list —
+    exactly the loss_grace philosophy, keyed on holder count instead
+    of wall clock. run_chaos marks such verdicts with
+    `beyond_stripe_contract: true` so a clean-looking run cannot
+    silently be one whose loss checking was waived.
     """
     violations: list[str] = []
+    beyond_stripe_contract = (
+        stripe is not None
+        and int(stripe.get("holders_down", 0)) > int(stripe.get("m", 0))
+    )
     produced: dict[str, dict] = {}
     for op in ops:
         if op.get("op") == "produce":
@@ -137,7 +156,7 @@ def check_history(ops: list[dict],
             in_grace = loss_grace is not None and t is not None and any(
                 t0 <= t <= t1 for t0, t1 in loss_grace
             )
-            if not in_grace:
+            if not in_grace and not beyond_stripe_contract:
                 violations.append(
                     f"acked loss: produce {payload!r} -> {part} acked "
                     f"(attempts={op.get('attempts', 1)}) but absent from "

@@ -74,6 +74,25 @@ _GROUP_OP_WEIGHTS = (
 
 _GROUP_OPS = tuple(n for n, _ in _GROUP_OP_WEIGHTS)
 
+# Stripe-holder ops (runs with replication="striped"): attack the
+# striped plane's k-of-k+m durability contract as a first-class
+# surface. Ops name a stripe INDEX (0..k+m-1) — the schedule stays a
+# pure function of the seed; resolution to a broker happens at apply
+# time through the cluster's replicated stripe map (like disk faults,
+# WHAT was hit is runtime forensics, the op itself is the trace).
+# stripe_kill crashes the holder of that index; stripe_partition
+# (in-proc only: needs network hooks) partitions it from the
+# controller. Scheduling is SIZED TO M: at most RS_M stripe_kills per
+# phase — the checker tests the contract the plane claims (zero acked
+# loss while any k stripe-holders survive); losing more is the
+# documented beyond-contract regime (chaos/history.py check_history's
+# stripe parameter).
+_STRIPE_OP_WEIGHTS = (
+    ("stripe_kill", 2),
+    ("stripe_partition", 1),
+)
+_STRIPE_OPS = tuple(n for n, _ in _STRIPE_OP_WEIGHTS)
+
 
 def make_schedule(
     seed: int,
@@ -83,38 +102,69 @@ def make_schedule(
     lockstep_workers: tuple[str, ...] = (),
     backend: str = "inproc",
     group_members: int = 0,
+    striped: bool = False,
 ) -> list[list[dict]]:
     """Deterministic [phases][ops] fault schedule. Each phase ends with
     an implicit heal (the nemesis records it in the trace), so phases
     start from a clean network with every broker up. `backend` selects
     the op pool ("inproc": network+crash faults; "proc": SIGKILL + disk
-    faults); `group_members > 0` joins the rebalance-storm ops to it —
+    faults); `group_members > 0` joins the rebalance-storm ops,
+    `striped` the stripe-holder ops (sized to RS_M kills per phase) —
     the schedule stays a pure function of (seed, roster, shape,
-    backend, group_members), so any run replays byte-for-byte."""
+    backend, group_members, striped), so any run replays byte-for-byte."""
+    from ripplemq_tpu.stripes.codec import RS_K, RS_M
+
     rng = random.Random(seed)
     pool = list(_BACKEND_POOLS[backend])
     if lockstep_workers and backend == "inproc":
         pool.append(("kill_worker", 1))
     if group_members > 0:
         pool.extend(_GROUP_OP_WEIGHTS)
+    if striped:
+        pool.extend(
+            _STRIPE_OP_WEIGHTS if backend == "inproc"
+            else _STRIPE_OP_WEIGHTS[:1]  # partition needs network hooks
+        )
     names = [n for n, w in pool for _ in range(w)]
     max_crashed = (len(broker_ids) - 1) // 2
     schedule: list[list[dict]] = []
     for phase in range(phases):
         ops: list[dict] = []
         crashed: set[int] = set()
+        stripe_kills = 0
         for _ in range(ops_per_phase):
             name = rng.choice(names)
-            if name == "crash" and len(crashed) >= max_crashed:
+            if name == "crash" and len(crashed) + stripe_kills >= max_crashed:
                 # Keep the metadata majority alive: the checker tests
                 # safety under faults the system claims to survive.
+                # Stripe kills hold a crash slot too — each resolves to
+                # a real broker going down.
                 name = "partition" if backend == "inproc" else "disk_torn"
-            if name in _DISK_OPS:
+            if name == "stripe_kill" and (
+                stripe_kills >= RS_M
+                or len(crashed) + stripe_kills + 1 > max_crashed
+            ):
+                # Sized to m, and stripe kills consume the crash budget
+                # (the holder they resolve to is a real broker down).
+                name = ("stripe_partition" if backend == "inproc"
+                        else "disk_torn")
+            if name in _STRIPE_OPS:
+                if name == "stripe_kill":
+                    stripe_kills += 1
+                ops.append({"op": name,
+                            "holder": rng.randrange(RS_K + RS_M)})
+            elif name in _DISK_OPS:
                 # Disk damage is injected into a CRASHED victim's store
                 # (you cannot corrupt the disk under a live process and
                 # call the outcome a recovery test): target an already-
                 # crashed broker, or crash one first as part of the op.
                 if not crashed:
+                    if stripe_kills >= max_crashed:
+                        # The implicit crash would overdraw the budget
+                        # stripe kills already consumed (their victims
+                        # are unknown at schedule time, so they cannot
+                        # serve as disk-op targets either): skip.
+                        continue
                     b = rng.choice(sorted(broker_ids))
                     crashed.add(b)
                     ops.append({"op": "crash", "broker": b})
@@ -160,12 +210,21 @@ def expected_trace(schedule: list[list[dict]]) -> list[dict]:
     trace: list[dict] = []
     for phase, ops in enumerate(schedule):
         crashed: set[int] = set()
+        holders: set[int] = set()
         for op in ops:
             trace.append({"phase": phase, **op})
             if op["op"] == "crash":
                 crashed.add(op["broker"])
+            elif op["op"] == "stripe_kill":
+                holders.add(op["holder"])
         for b in sorted(crashed):
             trace.append({"phase": phase, "op": "restart", "broker": b})
+        # Stripe kills resolve to brokers only at APPLY time, so their
+        # restarts are traced by HOLDER INDEX (deterministic from the
+        # schedule) — which broker that was is timeline forensics.
+        for h in sorted(holders):
+            trace.append({"phase": phase, "op": "restart_holder",
+                          "holder": h})
         trace.append({"phase": phase, "op": "heal"})
     return trace
 
@@ -187,7 +246,8 @@ class Nemesis:
                  lockstep_workers: tuple[str, ...] = (),
                  schedule: Optional[list[list[dict]]] = None,
                  backend: str = "inproc",
-                 group_members: int = 0) -> None:
+                 group_members: int = 0,
+                 striped: bool = False) -> None:
         self.cluster = cluster
         self.seed = seed
         self.backend = backend
@@ -203,6 +263,7 @@ class Nemesis:
             lockstep_workers=self.lockstep_workers,
             backend=backend,
             group_members=group_members,
+            striped=striped,
         )
         self.trace: list[dict] = []
         # Disk-fault injection outcomes, parallel to the trace entries
@@ -217,6 +278,15 @@ class Nemesis:
         # byte-reproducible artifact remains `trace`.
         self.timeline: list[dict] = []
         self._crashed: set[int] = set()
+        # Stripe-op bookkeeping: brokers crashed by stripe_kill (kept
+        # apart from _crashed — their trace restarts are holder-indexed,
+        # see expected_trace) and the holder indexes hit this phase.
+        self._stripe_crashed: set[int] = set()
+        self._stripe_hit: set[int] = set()
+        # Per-run high-water mark of stripe_kills in one phase: the
+        # checker's k-of-k+m contract input (run_chaos passes it to
+        # check_history's stripe parameter).
+        self.max_stripe_kills_per_phase = 0
 
     def _mark(self, phase: int, op: dict) -> None:
         self.timeline.append({
@@ -240,9 +310,19 @@ class Nemesis:
         kind = op["op"]
         if kind == "crash":
             b = op["broker"]
+            if b in self._stripe_crashed:
+                # Already down via a stripe kill: adopt it into the
+                # broker-named set so the heal's named restart entry
+                # matches expected_trace (the crash op IS scheduled).
+                self._stripe_crashed.discard(b)
+                self._crashed.add(b)
+                return
             if b not in self._crashed:
                 self._crashed.add(b)
                 self.cluster.kill(b)
+            return
+        if kind in _STRIPE_OPS:
+            self._apply_stripe_op(kind, op)
             return
         if kind == "restart":
             b = op["broker"]
@@ -298,6 +378,41 @@ class Nemesis:
         else:
             raise ValueError(f"unknown nemesis op {kind!r}")
 
+    def _apply_stripe_op(self, kind: str, op: dict) -> None:
+        """Resolve a stripe-holder op against the CURRENT replicated
+        stripe map (the schedule names only the index; what broker that
+        is depends on membership history — recorded into disk_fault_log
+        -style forensics, never into the byte-reproducible trace)."""
+        h = op["holder"]
+        if kind == "stripe_kill":
+            self._stripe_hit.add(h)
+            self.max_stripe_kills_per_phase = max(
+                self.max_stripe_kills_per_phase, len(self._stripe_hit)
+            )
+        holders = tuple(self.cluster.stripe_holders())
+        resolved = None
+        if holders:
+            resolved = holders[h % len(holders)]
+        self.disk_fault_log.append({
+            "op": kind, "holder": h, "resolved_broker": resolved,
+        })
+        if resolved is None:
+            return  # no standby joined yet: nothing to attack
+        if kind == "stripe_kill":
+            if resolved in self._crashed or resolved in self._stripe_crashed:
+                return
+            self._stripe_crashed.add(resolved)
+            self.cluster.kill(resolved)
+            return
+        # stripe_partition: cut the holder off from the controller (the
+        # stripe stream's source) — its stripes stop acking, the round
+        # must settle through the other k holders.
+        ctrl = self.cluster.controller_id()
+        net = getattr(self.cluster, "net", None)
+        if net is None or ctrl is None or ctrl == resolved:
+            return
+        net.block(self._addr(resolved), self._addr(ctrl))
+
     def heal_phase(self, phase: int) -> None:
         """End-of-phase heal: clear every network fault, restart every
         crashed broker (recorded — the heal is part of the trace). A
@@ -311,6 +426,17 @@ class Nemesis:
             self.trace.append({"phase": phase, "op": "restart", "broker": b})
             self._mark(phase, {"op": "restart", "broker": b})
         self._crashed.clear()
+        # Stripe-killed brokers restart too, traced by HOLDER index
+        # (expected_trace cannot know the broker the map resolved to —
+        # the broker id goes to the wall-clocked timeline only).
+        for b in sorted(self._stripe_crashed):
+            self.cluster.restart(b)
+            self._mark(phase, {"op": "restart_stripe", "broker": b})
+        self._stripe_crashed.clear()
+        for h in sorted(self._stripe_hit):
+            self.trace.append({"phase": phase, "op": "restart_holder",
+                               "holder": h})
+        self._stripe_hit.clear()
         if net is not None:
             for w in self.lockstep_workers:
                 net.set_up(w)

@@ -123,6 +123,8 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "standby_count": config.standby_count,
         "segment_bytes": config.segment_bytes,
         "durability": config.durability,
+        "replication": config.replication,
+        "pid_retention_s": config.pid_retention_s,
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
     }
@@ -304,6 +306,35 @@ class ProcCluster:
         if not self._clients:
             return self.client("meta")
         return self._clients[0]
+
+    def stripe_holders(self) -> tuple[int, ...]:
+        """Replicated stripe→member map over the admin.stats surface
+        (the nemesis's stripe-op resolution; empty until a standby
+        joins or in full-copy mode)."""
+        client = self._meta_client()
+        for addr in self._live_addrs():
+            try:
+                resp = client.call(addr, {"type": "admin.stats"},
+                                   timeout=2.0)
+            except Exception:
+                continue
+            if resp.get("ok"):
+                return tuple(int(b) for b in
+                             resp.get("stripe_holders", ()))
+        return ()
+
+    def controller_id(self) -> Optional[int]:
+        client = self._meta_client()
+        for addr in self._live_addrs():
+            try:
+                resp = client.call(addr, {"type": "admin.stats"},
+                                   timeout=2.0)
+            except Exception:
+                continue
+            ctrl = resp.get("controller") or {}
+            if ctrl.get("id") is not None:
+                return int(ctrl["id"])
+        return None
 
     def controller_ready(self) -> bool:
         """Controller advertised AND at least one replication standby

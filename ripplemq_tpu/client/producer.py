@@ -20,6 +20,7 @@ would dedupe them away).
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Optional
 
@@ -47,6 +48,7 @@ class ProducerClient:
         retry_policy: Optional[RetryPolicy] = None,
         idempotence: bool = True,
         producer_name: Optional[str] = None,
+        pid_refresh_s: float = 60.0,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -59,6 +61,12 @@ class ProducerClient:
         self._idempotence = bool(idempotence)
         self._pid: Optional[int] = None
         self._pid_name = producer_name or f"producer/{uuid.uuid4().hex}"
+        # Session refresh: re-register (idempotent; the apply bumps the
+        # replicated seen counter) at this cadence so the metadata
+        # leader's pid reaper sees a live session. Keep it well under
+        # the server's pid_retention_s (default 600 s); 0 disables.
+        self._pid_refresh_s = float(pid_refresh_s)
+        self._pid_registered_t = 0.0
         self._seq_lock = threading.Lock()
         self._seqs: dict[tuple[str, int], int] = {}
         self._selector = selector or RoundRobinSelector()
@@ -163,13 +171,26 @@ class ProducerClient:
         return seq
 
     def _ensure_pid(self, addr: str, run) -> Optional[int]:
-        """Register this producer's id (once) with the metadata plane.
-        None on failure — the current call proceeds unstamped
-        (at-least-once, the pre-idempotence contract) and the next call
-        tries again; registration must never wedge the produce path
-        behind a leaderless metadata raft."""
+        """Register this producer's id (once) with the metadata plane,
+        then RE-register at pid_refresh_s cadence — registration of an
+        existing name is the session refresh keeping the pid out of the
+        reaper's idle window (ClusterConfig.pid_retention_s). None on
+        initial-registration failure — the current call proceeds
+        unstamped (at-least-once, the pre-idempotence contract) and the
+        next call tries again; a FAILED refresh keeps the cached pid
+        (best-effort: the pid stays valid until actually reaped, and a
+        reaped pid only costs the dedup window, never safety)."""
+        now = time.monotonic()
         if self._pid is not None:
-            return self._pid
+            if (self._pid_refresh_s <= 0
+                    or now - self._pid_registered_t < self._pid_refresh_s):
+                return self._pid
+            # Attempting a refresh: stamp the attempt BEFORE the RPC so
+            # a failing metadata plane costs one extra RPC per refresh
+            # WINDOW, not one per produce (the original registration's
+            # never-wedge-the-produce-path rule applies to refreshes
+            # too; the cached pid stays valid until actually reaped).
+            self._pid_registered_t = now
         try:
             resp = self._transport.call(
                 addr,
@@ -178,12 +199,13 @@ class ProducerClient:
             )
         except RpcError as e:
             run.note(f"pid registration: {e}")
-            return None
+            return self._pid
         if resp.get("ok"):
             self._pid = int(resp["pid"])
+            self._pid_registered_t = now
             return self._pid
         run.note(f"pid registration: {resp.get('error')}")
-        return None
+        return self._pid
 
     def produce_batch_async(self, topic: str, messages: list[bytes],
                             partition: Optional[int] = None):

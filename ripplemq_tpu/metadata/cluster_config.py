@@ -71,6 +71,27 @@ class ClusterConfig:
     # stream (the data plane survives the loss of the controller plus
     # standby_count - 1 standbys). 0 disables controller failover.
     standby_count: int = 2
+    # Replication plane: "full" streams a FULL copy of every committed
+    # round to every standby (R-times bytes); "striped" Reed–Solomon-
+    # encodes each sender group-commit into k+m stripes (stripes/codec:
+    # RS(3,2)) shipped to DISTINCT standbys — durable-copy bytes scale
+    # with (k+m)/k ≈ 1.67× instead of the standby count, the round
+    # settles at any k stripe-acks, and promotion rebuilds the full
+    # stream from any k surviving stripes (stripes/recovery.py).
+    # Committed prefixes are byte-identical across both modes. Striped
+    # pays off from 2 standbys (0.83× full-copy bytes) and approaches
+    # its 0.42× floor at 4 (R=5-equivalent durability).
+    replication: str = "full"
+    # Idempotent-producer pid retention: a pid idle (no registration
+    # refresh reaching the metadata plane) for longer than this is
+    # REAPED by the metadata leader via a replicated op whose apply
+    # re-checks idleness, so a racing refresh always wins. Producers
+    # and broker stamping pids refresh well inside the window
+    # (ProducerClient pid_refresh_s; _producer_pid_duty); a reaped pid
+    # is never reissued (the pid counter is monotone), so a zombie
+    # producer merely loses its dedup window, never its safety. 0
+    # disables reaping (the PR 7 grow-forever behavior).
+    pid_retention_s: float = 600.0
     # Round-store segment rotation threshold (sealed segments are
     # erasure-coded and their shards distributed to peer brokers).
     segment_bytes: int = 64 << 20
@@ -140,6 +161,13 @@ class ClusterConfig:
                 f"durability must be 'async' or 'strict', "
                 f"got {self.durability!r}"
             )
+        if self.replication not in ("full", "striped"):
+            raise ValueError(
+                f"replication must be 'full' or 'striped', "
+                f"got {self.replication!r}"
+            )
+        if self.pid_retention_s < 0:
+            raise ValueError("pid_retention_s must be >= 0 (0 disables)")
         # Shards (~segment_bytes / 3 each) travel in single wire frames
         # (shard.put / shard.get), which the codec hard-caps at 64 MB —
         # an oversize segment would make shard distribution fail forever.
@@ -260,6 +288,10 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["obs"] = bool(raw["obs"])
     if "durability" in raw:
         extra["durability"] = str(raw["durability"])
+    if "replication" in raw:
+        extra["replication"] = str(raw["replication"])
+    if "pid_retention_s" in raw:
+        extra["pid_retention_s"] = float(raw["pid_retention_s"])
     if "coalesce_s" in raw:
         extra["coalesce_s"] = float(raw["coalesce_s"])
     if "read_coalesce_s" in raw:

@@ -21,7 +21,11 @@ Protection window note: protect_store treats shard-file PRESENCE of a
 complete set as protected without re-reading shard CRCs (a full CRC scrub
 per flush would defeat the off-path design), so a shard that rots on disk
 silently lowers that segment's loss tolerance below m until the next
-boot-time repair_store pass validates and rewrites it.
+boot. The window CLOSES at boot: repair_store validates every shard's
+CRC and rewrites any set short of k+m valid shards — including a fully
+rotted or mixed-generation set over a healthy segment, which is
+re-encoded fresh (directed coverage: tests/test_storage.py shard-rot
+repair tests).
 """
 
 from __future__ import annotations
@@ -35,8 +39,11 @@ import numpy as np
 
 from ripplemq_tpu.ops.rs import rs_encode, rs_reconstruct
 
-K = 3
-M = 2
+# ONE RS geometry for the whole repo: the sealed-segment shards here and
+# the hot-path replication stripes (ripplemq_tpu/stripes/) share the
+# codec constants, so both reconstruct with the same extended-Cauchy
+# matrices and a deployment reasons about a single k-of-k+m contract.
+from ripplemq_tpu.stripes.codec import RS_K as K, RS_M as M
 
 _MAGIC = 0x52535348  # "RSSH"
 _VERSION = 1
@@ -366,7 +373,20 @@ def repair_store(store_dir: str, **kw) -> list[str]:
                 gens.add((o, c))
                 valid_shards += 1
         if len(gens) != 1:
-            continue  # dead or mixed-generation shard set; scanner handles it
+            # No single consistent generation survives: every shard
+            # rotted, or stale stragglers disagree. protect_store counts
+            # shard-file PRESENCE (the documented protection window), so
+            # without this branch such a set would stay "protected"
+            # while protecting nothing. If the segment file itself is
+            # readable, re-encode a fresh consistent set from it; an
+            # unreadable segment with no usable shards stays the
+            # scanner's problem, as before.
+            if os.path.isfile(seg_path):
+                try:
+                    encode_segment(store_dir, name, **kw)
+                except Exception:
+                    pass  # derived data: never block recovery/boot
+            continue
         orig_len, data_crc = next(iter(gens))
         try:
             with open(seg_path, "rb") as f:

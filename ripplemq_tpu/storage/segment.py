@@ -35,6 +35,15 @@ REC_META = 3
 # persisted). Payload: packed (pid u32, seq i64, rows u32, base i64)
 # per producer batch; `base` in the header carries the entry count.
 REC_PIDSEQ = 4
+# Striped replication (ripplemq_tpu/stripes/): a standby in
+# replication="striped" mode persists Reed–Solomon stripe FRAMES of the
+# committed-round stream instead of full rows. Header fields: slot =
+# stripe index, base = gsn & 0x7FFFFFFF (display/filtering only — the
+# self-describing frame header inside the payload is the authority);
+# payload = one stripes/codec.py frame (its own header-covered CRC on
+# top of this store frame's). Promotion/boot replay reconstructs the
+# record stream from any k of the k+m stripes (stripes/recovery.py).
+REC_STRIPE = 5
 
 _MAGIC = 0x474C5152
 _HEADER = struct.Struct("<IBIIII")  # magic, type, slot, base, len, crc

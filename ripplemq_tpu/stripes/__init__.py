@@ -1,0 +1,56 @@
+"""Striped replication plane: Reed–Solomon erasure coding on the hot
+replication path (see stripes/codec.py for the geometry and frame
+format, stripes/plane.py for the sender, stripes/recovery.py for the
+rebuilt-from-any-k promotion path).
+
+The codec is imported eagerly (it is the shared-geometry anchor
+storage/erasure.py depends on); the plane and recovery modules load
+LAZILY — they import the broker stack, and `storage.erasure →
+stripes.codec` must not drag broker/server machinery into every
+store open (the groups package learned the same lesson in PR 7)."""
+
+from ripplemq_tpu.stripes.codec import (
+    RS_K,
+    RS_M,
+    StripeFrame,
+    StripeShortError,
+    encode_group,
+    parse_frame,
+    reconstruct_group,
+    stripe_assignment,
+)
+
+__all__ = [
+    "RS_K",
+    "RS_M",
+    "StripeFrame",
+    "StripeShortError",
+    "StripeReplicator",
+    "StripeDataLossError",
+    "StripeRecoveryError",
+    "encode_group",
+    "parse_frame",
+    "reconstruct_group",
+    "rebuild_records",
+    "stripe_assignment",
+]
+
+_LAZY = {
+    "StripeReplicator": ("ripplemq_tpu.stripes.plane", "StripeReplicator"),
+    "StripeDataLossError": (
+        "ripplemq_tpu.stripes.recovery", "StripeDataLossError",
+    ),
+    "StripeRecoveryError": (
+        "ripplemq_tpu.stripes.recovery", "StripeRecoveryError",
+    ),
+    "rebuild_records": ("ripplemq_tpu.stripes.recovery", "rebuild_records"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target[0]), target[1])

@@ -79,6 +79,60 @@ def test_fixed_seed_chaos_smoke(seed):
     assert trace_json(verdict["trace"]) == trace_json(expected_trace(sched))
 
 
+def test_striped_chaos_smoke():
+    """ISSUE 9 acceptance: striped replication under a fixed schedule
+    with STRIPE FAULTS in it — a standby crashed with a disk fault
+    landing in its stripe store (standby segments hold REC_STRIPE
+    frames in striped mode, so disk_flip rot hits stripe bytes by
+    construction), then a stripe-holder kill. Zero violations under the
+    k-of-k+m loss accounting, bounded re-convergence, and the verdict
+    names the replication plane."""
+    from ripplemq_tpu.chaos import run_chaos
+
+    schedule = [
+        [{"op": "crash", "broker": 1},
+         {"op": "disk_flip", "broker": 1, "salt": 11}],
+        [{"op": "stripe_kill", "holder": 0}],
+    ]
+    verdict = run_chaos(seed=11, n_brokers=4, phases=2, phase_s=0.5,
+                        schedule=schedule, replication_mode="striped",
+                        converge_timeout_s=90.0)
+    assert verdict["replication"] == "striped"
+    assert verdict["violations"] == [], verdict["violations"]
+    assert verdict["converged"], verdict["convergence"]
+    ops = [t["op"] for t in verdict["trace"]]
+    assert "stripe_kill" in ops and "disk_flip" in ops
+    assert "restart_holder" in ops  # holder-indexed restart in trace
+    assert verdict["counts"]["produce_ok"] > 0
+    assert sum(verdict["final_log_sizes"].values()) > 0
+    # The stripe kill resolved against the replicated map (forensics).
+    hits = [d for d in verdict["disk_faults"] if d.get("op") == "stripe_kill"]
+    assert hits and "resolved_broker" in hits[0]
+
+
+def test_striped_schedule_sizes_stripe_kills_to_m():
+    from ripplemq_tpu.stripes.codec import RS_M
+
+    for seed in range(25):
+        sched = make_schedule(seed, list(range(5)), phases=3,
+                              ops_per_phase=5, striped=True)
+        for ops in sched:
+            kills = [op for op in ops if op["op"] == "stripe_kill"]
+            crashed = {op["broker"] for op in ops if op["op"] == "crash"}
+            assert len(kills) <= RS_M, (seed, ops)
+            # Stripe kills consume the crash budget: the combined
+            # concurrent downage keeps the metadata majority alive.
+            assert len(crashed) + len(kills) <= (5 - 1) // 2, (seed, ops)
+    # The pool actually draws them.
+    assert any(
+        op["op"] in ("stripe_kill", "stripe_partition")
+        for seed in range(10)
+        for ops in make_schedule(seed, [0, 1, 2, 3], phases=2,
+                                 ops_per_phase=3, striped=True)
+        for op in ops
+    )
+
+
 def test_schedule_is_a_pure_function_of_the_seed():
     for seed in (0, 1, 2, 3, 42, 1337):
         a = make_schedule(seed, [0, 1, 2], phases=4, ops_per_phase=3)

@@ -76,3 +76,33 @@ def test_randomized_group_storm_seed(seed):
         f"seed {seed} unconverged: {verdict['convergence']} / "
         f"{verdict['group']}\ntrace: {trace_json(verdict['trace'])}"
     )
+
+
+# Striped-replication soak (ISSUE 9): the same randomized pool plus
+# the STRIPE-HOLDER ops (stripe_kill / stripe_partition, sized to m),
+# on a cluster wide enough for a 3-deep standby set. The checker holds
+# every run to the k-of-k+m loss contract; the fixed-schedule tier-1
+# gate lives in test_chaos.py::test_striped_chaos_smoke.
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_randomized_striped_soak_seed(seed):
+    verdict = run_chaos(
+        seed=seed,
+        n_brokers=5,
+        partitions=3,
+        phases=3,
+        phase_s=0.8,
+        ops_per_phase=3,
+        replication_mode="striped",
+        converge_timeout_s=60.0,
+    )
+    assert verdict["violations"] == [], (
+        f"seed {seed}: {verdict['violations']}\n"
+        f"replay: python profiles/chaos_soak.py --seed {seed} "
+        f"--brokers 5 --partitions 3 --phases 3 --ops-per-phase 3 "
+        f"--replication striped\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )
+    assert verdict["converged"], (
+        f"seed {seed} unconverged: {verdict['convergence']}\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )

@@ -58,6 +58,7 @@ FAST_MODULES = {
     "test_observability",
     "test_op_split",
     "test_packaging",
+    "test_pid_expiry",          # ~10 s: reaper units + one churn cluster
     "test_proc_chaos",          # ~2 min: 2-seed real-subprocess chaos smoke
     "test_process_cluster",     # ~20 s: real-subprocess broker boot
     "test_read_batching",
@@ -74,6 +75,7 @@ FAST_MODULES = {
     "test_spmd",
     "test_storage",
     "test_store_gc",            # ~17 s: GC/retention store churn
+    "test_stripes",             # ~30 s: any-k matrix + 3 striped clusters
     "test_store_migrate",
     "test_stride_rule",
     "test_wire",

@@ -227,6 +227,12 @@ STATS_TOP_KEYS = {
     # the idempotent-producer registry size, and recycled consumer
     # slots awaiting their offset reset.
     "groups", "producer_ids", "dirty_consumer_slots",
+    # ISSUE 9: the striped-replication surface — active plane
+    # ("full"|"striped"), the replicated stripe→member assignment
+    # (stripe i held by stripe_holders[i]; empty before a standby
+    # joins or in full-copy mode), and how many any-k promotion
+    # rebuilds this process ran.
+    "stripe_mode", "stripe_holders", "stripe_rebuilds",
 }
 STATS_ENGINE_KEYS = {
     "mode", "rounds", "dispatches", "read_queries", "read_dispatches",
@@ -265,6 +271,15 @@ def test_admin_stats_schema_lock():
         assert stats["groups"] == {}
         assert isinstance(stats["producer_ids"], int)
         assert stats["dirty_consumer_slots"] == []
+        # Striped-replication surface (ISSUE 9): a full-copy cluster
+        # advertises the mode with an empty holder map and zero
+        # rebuilds; value shapes pinned here, striped values by
+        # tests/test_stripes.py.
+        assert stats["stripe_mode"] == "full"
+        assert stats["stripe_holders"] == [] or all(
+            isinstance(b, int) for b in stats["stripe_holders"]
+        )
+        assert stats["stripe_rebuilds"] == 0
         resp = client.call(
             ctrl.addr,
             {"type": "group.join", "group": "schema-g", "member": "m0",

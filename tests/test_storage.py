@@ -13,6 +13,7 @@ from ripplemq_tpu.storage.segment import (
     REC_OFFSETS,
     CorruptStoreError,
     SegmentStore,
+    list_segment_files,
     native_available,
     scan_store,
 )
@@ -378,6 +379,83 @@ def test_header_bit_flip_fails_verification(tmp_path, write_native, flip_at):
         verify_store(d)
     with pytest.raises(CorruptStoreError):
         list(scan_store(d, use_native=False))
+
+
+def test_boot_repair_rewrites_a_rotted_shard(tmp_path):
+    """ISSUE 9 satellite: the protection window the erasure docstring
+    documents closes at boot — rot ONE shard on disk, run the
+    boot-time repair pass, and the shard set is whole again (k+m valid
+    shards, segment untouched)."""
+    from ripplemq_tpu.storage.erasure import (
+        K,
+        M,
+        _read_shard,
+        protect_store,
+        repair_store,
+        shard_paths,
+    )
+
+    d, recs = _faulted_store(tmp_path, protect=False)
+    protect_store(d)
+    name = list_segment_files(d)[0]
+    paths = shard_paths(d, name)
+    assert all(_read_shard(p) is not None for p in paths)
+    # Rot one shard's payload byte: CRC-invalid, file still present —
+    # protect_store counts PRESENCE, so only boot repair can heal it.
+    with open(paths[1], "r+b") as f:
+        f.seek(40)
+        b = f.read(1)
+        f.seek(40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert _read_shard(paths[1]) is None
+    assert protect_store(d) == []  # the documented window: no-op
+    repair_store(d)
+    assert all(_read_shard(p) is not None for p in paths), (
+        "boot repair left the set short of k+m valid shards"
+    )
+    assert len(paths) == K + M
+    assert list(scan_store(d, use_native=False)) == recs
+
+
+def test_boot_repair_reencodes_a_fully_rotted_shard_set(tmp_path):
+    """The deeper half of the same gap: EVERY shard rotted over a
+    healthy segment left no consistent generation — the old repair
+    skipped the set entirely while protect_store kept counting it
+    protected. Boot repair now re-encodes a fresh set from the
+    segment bytes."""
+    from ripplemq_tpu.storage.erasure import (
+        _read_shard,
+        protect_store,
+        repair_store,
+        shard_paths,
+    )
+
+    d, recs = _faulted_store(tmp_path, protect=False)
+    protect_store(d)
+    name = list_segment_files(d)[0]
+    paths = shard_paths(d, name)
+    for p in paths:
+        with open(p, "r+b") as f:
+            f.seek(33)
+            b = f.read(1)
+            f.seek(33)
+            f.write(bytes([b[0] ^ 0xFF]))
+    assert all(_read_shard(p) is None for p in paths)
+    repair_store(d)
+    assert all(_read_shard(p) is not None for p in paths), (
+        "fully-rotted shard set was not re-encoded from the segment"
+    )
+    assert list(scan_store(d, use_native=False)) == recs
+
+
+def test_erasure_and_stripes_share_one_rs_geometry():
+    """ONE RS geometry (ISSUE 9 satellite): the sealed-segment shard
+    plane's constants ARE the stripe plane's codec constants, so both
+    reconstruct with the same extended-Cauchy matrices."""
+    from ripplemq_tpu.storage import erasure
+    from ripplemq_tpu.stripes.codec import RS_K, RS_M
+
+    assert (erasure.K, erasure.M) == (RS_K, RS_M)
 
 
 def test_quarantine_store_moves_damage_aside(tmp_path):
