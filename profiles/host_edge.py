@@ -144,8 +144,7 @@ def main() -> None:
         # offset-0 read would take the STORE path and mislabel the
         # decomposition. Park the consumer one window below the log end
         # — mirror-resident by construction — and read there.
-        with dp._lock:
-            tail = max(0, int(dp._log_end[0]) - 256)
+        tail = max(0, dp.log_end(0) - 256)
         assert tail >= int(dp.trim[0]), "tail window fell below trim"
         cm = client.call(addr, {"type": "offset.commit", "topic": "bench",
                                 "partition": 0, "consumer": "edge",

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""ripplelint CLI: run the repo-native static-analysis plane.
+
+    python profiles/lint.py                   # human-readable findings
+    python profiles/lint.py --json            # machine verdict
+    python profiles/lint.py --rule markers    # one rule (repeatable)
+    python profiles/lint.py --list            # known rules
+
+Exit status 0 iff the tree is clean: zero unwaived findings AND zero
+stale waivers (a suppression that stopped matching is coverage rot and
+fails just like a finding). The JSON verdict carries per-checker
+finding counts and runtimes so CI can budget the lint wall-time
+against the tier-1 870 s ceiling (whole-tree runs measure ~2-3 s on
+the 2-core build host — it is AST parsing, no imports of the checked
+modules, no device).
+
+Waiving a finding: add a `(rule, key, reason)` entry to
+`ripplemq_tpu/analysis/ledger.py` — the key is printed with every
+finding; the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from ripplemq_tpu.analysis import CHECKERS, run_lint
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable verdict")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list known rules and exit")
+    args = ap.parse_args()
+
+    if args.list:
+        for rule in CHECKERS:
+            print(rule)
+        return 0
+
+    report = run_lint(rules=args.rule)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0 if report["ok"] else 1
+
+    for rule, c in report["checkers"].items():
+        waived = f", {len(c['waived'])} waived" if c["waived"] else ""
+        print(f"{rule}: {c['count']} finding(s){waived} "
+              f"[{c['runtime_s']:.2f}s]")
+        for f in c["findings"]:
+            print(f"  {f['path']}:{f['line']}: {f['message']}")
+            print(f"      key: {f['key']}")
+    for w in report["stale_waivers"]:
+        print(f"STALE WAIVER {w['rule']}::{w['key']} — no finding matches "
+              f"(remove it from analysis/ledger.py)")
+    status = "clean" if report["ok"] else "DIRTY"
+    print(f"ripplelint: {status} — {report['unwaived_total']} unwaived "
+          f"finding(s), {len(report['stale_waivers'])} stale waiver(s), "
+          f"{report['runtime_s']:.2f}s")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""ripplelint — repo-native static analysis (see framework.py).
+
+`CHECKERS` is the ordered rule registry; `run_lint()` the entry point
+(`profiles/lint.py` is the CLI, `tests/test_lint.py` the tier-1 gate).
+"""
+
+from ripplemq_tpu.analysis import (  # noqa: F401
+    config_plumbing,
+    determinism,
+    lock_discipline,
+    markers,
+    retry_taxonomy,
+    shard_shapes,
+    stats_schema,
+    trace_vocab,
+)
+from ripplemq_tpu.analysis.framework import (  # noqa: F401
+    Finding,
+    LedgerError,
+    Repo,
+    Waiver,
+    run_lint,
+)
+
+CHECKERS = {
+    lock_discipline.RULE: lock_discipline.check,
+    config_plumbing.RULE: config_plumbing.check,
+    retry_taxonomy.RULE: retry_taxonomy.check,
+    determinism.RULE: determinism.check,
+    shard_shapes.RULE: shard_shapes.check,
+    stats_schema.RULE: stats_schema.check,
+    trace_vocab.RULE: trace_vocab.check,
+    markers.RULE: markers.check,
+}

@@ -1,0 +1,244 @@
+"""shard_map shape rule: no global-P allocations inside smapped bodies.
+
+Under `shard_map`, the core step functions see `[local_P]` SHARDS of
+every per-partition argument — but `cfg.partitions` is still the GLOBAL
+count, so a `jnp.zeros((cfg.partitions,))` inside an smapped body
+builds a global-shaped array on every device: at best a shape error at
+trace time, at worst silent wrong masking when broadcasting happens to
+line up. Until now this rule was a comment in `core/step.py`
+("the spmd wrappers always pass quorum/trim explicitly"); this checker
+mechanizes it:
+
+- The smapped function set is DERIVED, not hand-listed: parse
+  `parallel/engine.py` for inner defs handed to `_smap(...)`, collect
+  which `core.step` imports they call, and close transitively over
+  `core/step.py`'s internal call graph.
+- Inside those functions, any array-allocating call (`jnp.zeros/ones/
+  full/empty/arange/broadcast_to/tile`) whose arguments reach
+  `cfg.partitions` (directly or through a local alias like
+  `P = cfg.partitions`) is a finding — UNLESS it sits under the
+  documented local-binding idiom `if <param> is None:` (the default
+  fill the spmd wrappers are required to pre-empt).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ripplemq_tpu.analysis.framework import Finding, Repo, func_defs
+
+RULE = "shard_shapes"
+
+ENGINE_PATH = "ripplemq_tpu/parallel/engine.py"
+STEP_PATH = "ripplemq_tpu/core/step.py"
+STEP_MODULE = "ripplemq_tpu.core.step"
+
+_ALLOC_FNS = {"zeros", "ones", "full", "empty", "arange",
+              "broadcast_to", "tile", "zeros_like", "full_like"}
+
+
+def smapped_step_fns(engine_tree: ast.AST) -> set[str]:
+    """Names of core.step functions reachable from a shard_map body:
+    inner defs passed to `_smap(f, ...)` in parallel/engine.py, closed
+    over the engine's local helpers, mapped through every way the
+    module reaches core.step — `from ...core.step import a as b`,
+    a module alias (`from ...core import step as core_step` /
+    `import ...core.step as s`), and one level of closure indirection
+    (`ctrl_fn = core_step.x if fused else core_step.y`)."""
+    direct: dict[str, str] = {}      # local name -> step fn name
+    mod_aliases: set[str] = set()    # names bound to the step MODULE
+    for node in ast.walk(engine_tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("core.step"):
+                for a in node.names:
+                    direct[a.asname or a.name] = a.name
+            elif node.module.endswith("core"):
+                for a in node.names:
+                    if a.name == "step":
+                        mod_aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("core.step"):
+                    mod_aliases.add(a.asname or a.name.split(".")[0])
+
+    def step_refs(node: ast.AST) -> set[str]:
+        """core.step function names referenced anywhere under node."""
+        out: set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and \
+                    n.value.id in mod_aliases:
+                out.add(n.attr)
+            elif isinstance(n, ast.Name) and n.id in direct:
+                out.add(direct[n.id])
+        return out
+
+    # Closure indirections: `name = <expr referencing step fns>`.
+    indirect: dict[str, set[str]] = {}
+    for node in ast.walk(engine_tree):
+        if isinstance(node, ast.Assign):
+            refs = step_refs(node.value)
+            if refs:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        indirect.setdefault(t.id, set()).update(refs)
+
+    defs = {f.name: f for f in func_defs(engine_tree)}
+    smapped_inner: set[str] = set()
+    for node in ast.walk(engine_tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("_smap", "shard_map")
+                and node.args and isinstance(node.args[0], ast.Name)):
+            smapped_inner.add(node.args[0].id)
+
+    # Close over the engine's own helpers a smapped body calls.
+    out: set[str] = set()
+    seen: set[str] = set()
+    frontier = [n for n in smapped_inner if n in defs]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        fn = defs[name]
+        out |= step_refs(fn)
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and n.id in indirect:
+                out |= indirect[n.id]
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in defs and n.func.id not in seen):
+                frontier.append(n.func.id)
+    return out
+
+
+def _close_over_step(step_tree: ast.AST, roots: set[str]) -> set[str]:
+    """Transitive closure of `roots` over core/step.py's module-level
+    call graph (a helper a smapped fn calls runs under shard_map too)."""
+    module_fns = {f.name: f for f in func_defs(step_tree)}
+    closed = set(roots)
+    frontier = list(roots)
+    while frontier:
+        fn = module_fns.get(frontier.pop())
+        if fn is None:
+            continue
+        for n in ast.walk(fn):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id in module_fns
+                    and n.func.id not in closed):
+                closed.add(n.func.id)
+                frontier.append(n.func.id)
+    return closed
+
+
+def _partition_aliases(fn: ast.AST) -> set[str]:
+    """Local names bound (anywhere in fn) to `cfg.partitions`."""
+    aliases: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Attribute) \
+                and n.value.attr == "partitions":
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    aliases.add(t.id)
+        # Tuple unpack `S, B, P = cfg.slots, cfg.max_batch, cfg.partitions`
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Tuple) \
+                and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Tuple):
+            for tgt, val in zip(n.targets[0].elts, n.value.elts):
+                if (isinstance(tgt, ast.Name)
+                        and isinstance(val, ast.Attribute)
+                        and val.attr == "partitions"):
+                    aliases.add(tgt.id)
+    return aliases
+
+
+def _reaches_partitions(node: ast.AST, aliases: set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "partitions":
+            return True
+        if isinstance(n, ast.Name) and n.id in aliases:
+            return True
+    return False
+
+
+def _none_guard_params(fn: ast.FunctionDef) -> set[str]:
+    args = fn.args
+    return {a.arg for a in
+            (*args.posonlyargs, *args.args, *args.kwonlyargs)}
+
+
+def _is_none_guard(test: ast.AST, params: set[str]) -> bool:
+    return (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Is)
+            and isinstance(test.left, ast.Name)
+            and test.left.id in params
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+def _alloc_findings_in(fn: ast.FunctionDef, path: str) -> list[Finding]:
+    params = _none_guard_params(fn)
+    aliases = _partition_aliases(fn)
+    findings: list[Finding] = []
+
+    def scan_expr(node: ast.AST, allowed: bool) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name not in _ALLOC_FNS or allowed:
+                continue
+            if any(_reaches_partitions(a, aliases)
+                   for a in (*n.args, *n.keywords)):
+                findings.append(Finding(
+                    rule=RULE, path=path, line=n.lineno,
+                    key=f"{path}::{fn.name}::{name}",
+                    message=(
+                        f"`{name}` allocation shaped by cfg.partitions "
+                        f"inside smapped function {fn.name}() — under "
+                        f"shard_map this body sees [local_P] shards; "
+                        f"thread the array in as an argument (the spmd "
+                        f"wrappers fill defaults before the smapped call)"
+                    ),
+                ))
+
+    def visit(stmts: list, allowed: bool) -> None:
+        for st in stmts:
+            if isinstance(st, ast.If):
+                visit(st.body, allowed or _is_none_guard(st.test, params))
+                visit(st.orelse, allowed)
+                scan_expr(st.test, allowed)
+            elif isinstance(st, (ast.For, ast.While, ast.With, ast.Try)):
+                for field in ("body", "orelse", "finalbody"):
+                    visit(getattr(st, field, []) or [], allowed)
+                for h in getattr(st, "handlers", []) or []:
+                    visit(h.body, allowed)
+            else:
+                scan_expr(st, allowed)
+
+    visit(fn.body, False)
+    return findings
+
+
+def alloc_findings(step_tree: ast.AST, smapped: set[str],
+                   path: str = STEP_PATH) -> list[Finding]:
+    closed = _close_over_step(step_tree, smapped)
+    findings: list[Finding] = []
+    for fn in func_defs(step_tree):
+        if fn.name in closed:
+            findings.extend(_alloc_findings_in(fn, path))
+    return findings
+
+
+def check(repo: Repo) -> list[Finding]:
+    smapped = smapped_step_fns(repo.tree(ENGINE_PATH))
+    if not smapped:
+        return [Finding(
+            rule=RULE, path=ENGINE_PATH, line=1, key="structure::smapped",
+            message=("no smapped core.step functions derivable from "
+                     "parallel/engine.py — the derivation in "
+                     "analysis/shard_shapes.py no longer matches the "
+                     "engine's binding idiom"),
+        )]
+    return alloc_findings(repo.tree(STEP_PATH), smapped)

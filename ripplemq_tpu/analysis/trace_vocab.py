@@ -1,0 +1,111 @@
+"""Trace-event vocabulary: emit sites match the documented set.
+
+The flight recorder (`obs/trace.py`) is only a diagnosis surface if
+the event names it records are a CLOSED VOCABULARY: timeline tooling,
+chaos-verdict readers, and the README all key on them. PR 9 added
+`stripe_rebuild` emits without touching the documented set — exactly
+the drift this checker stops:
+
+- `obs/trace.py` owns the canonical `EVENT_TYPES` frozenset.
+- Every library emit site — a positional string literal handed to a
+  `.record("name", ...)` call — must name a member. (The chaos
+  HISTORY's `history.record(op=...)` calls are keyword-only and thus
+  naturally out of scope; histories are operation logs, not traces.)
+- Every member must still have at least one emit site (a dead name is
+  a renamed event whose documentation now lies).
+- Every member must appear in the README Observability section.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ripplemq_tpu.analysis.framework import (
+    Finding,
+    Repo,
+    markdown_section,
+)
+
+RULE = "trace_vocab"
+
+TRACE_PATH = "ripplemq_tpu/obs/trace.py"
+VOCAB_NAME = "EVENT_TYPES"
+SCAN_ROOTS = ("ripplemq_tpu",)
+README_PATH = "README.md"
+README_HEADING = "## Observability"
+
+
+def vocabulary(trace_tree: ast.AST) -> frozenset:
+    for node in trace_tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == VOCAB_NAME
+                for t in node.targets):
+            return frozenset(
+                n.value for n in ast.walk(node.value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            )
+    return frozenset()
+
+
+def emit_sites(tree: ast.AST) -> list[tuple[int, str]]:
+    """(line, event-name) for every `<expr>.record("name", ...)` call
+    with a positional string-literal first argument."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.lineno, node.args[0].value))
+    return out
+
+
+def check(repo: Repo) -> list[Finding]:
+    findings: list[Finding] = []
+    vocab = vocabulary(repo.tree(TRACE_PATH))
+    if not vocab:
+        findings.append(Finding(
+            rule=RULE, path=TRACE_PATH, line=1, key="structure::vocab",
+            message=f"{VOCAB_NAME} missing from obs/trace.py — the "
+                    f"canonical event vocabulary must live beside the "
+                    f"recorder"))
+        return findings
+
+    emitted: set[str] = set()
+    for path in repo.py_files(*SCAN_ROOTS):
+        if path.startswith("ripplemq_tpu/analysis/"):
+            continue
+        for line, name in emit_sites(repo.tree(path)):
+            emitted.add(name)
+            if name not in vocab:
+                findings.append(Finding(
+                    rule=RULE, path=path, line=line,
+                    key=f"undocumented::{name}",
+                    message=(f"trace event {name!r} emitted but absent "
+                             f"from obs.trace.{VOCAB_NAME} — extend the "
+                             f"vocabulary (and the README) or rename the "
+                             f"emit"),
+                ))
+    for name in sorted(vocab - emitted):
+        findings.append(Finding(
+            rule=RULE, path=TRACE_PATH, line=1, key=f"dead::{name}",
+            message=(f"vocabulary event {name!r} has no emit site — "
+                     f"remove it or restore the emit"),
+        ))
+
+    body = markdown_section(repo.text(README_PATH), README_HEADING)
+    if not body:
+        findings.append(Finding(
+            rule=RULE, path=README_PATH, line=1, key="readme::section",
+            message=f"README {README_HEADING!r} section missing"))
+        return findings
+    for name in sorted(vocab):
+        if f"`{name}`" not in body:
+            findings.append(Finding(
+                rule=RULE, path=README_PATH, line=1, key=f"readme::{name}",
+                message=(f"trace event `{name}` undocumented in the "
+                         f"README Observability section"),
+            ))
+    return findings

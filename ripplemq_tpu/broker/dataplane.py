@@ -643,6 +643,14 @@ class DataPlane:
         with self._lock:
             return int(self._settled_end[slot])
 
+    def log_end(self, slot: int) -> int:
+        """The slot's host-shadow log end (device-committed absolute
+        offset), under the plane's lock — the settled_end() pattern:
+        external readers (profiles, admin surfaces) must not reach into
+        `_log_end` bare while the resolver advances it."""
+        with self._lock:
+            return int(self._log_end[slot])
+
     def stalled_slots(self, threshold: Optional[int] = None) -> list[int]:
         """Slots whose last `threshold` dispatched rounds ALL failed to
         commit on device (default: 2x the per-submit retry budget, so a

@@ -634,6 +634,13 @@ class BrokerServer:
         self.runner.start()
         self._duty_thread.start()
 
+    @property
+    def stopped(self) -> bool:
+        """True once stop() ran (or before __init__ completed) — the
+        liveness probe harnesses poll instead of reaching into
+        `_stopped` bare."""
+        return self._stopped
+
     def stop(self) -> None:
         # Idempotent: a killed-but-never-restarted broker is stopped
         # again by harness/cluster teardown, and the second pass must
@@ -915,7 +922,8 @@ class BrokerServer:
         if t == "shard.put":
             name = str(req["name"])
             if not valid_shard_name(name):
-                return {"ok": False, "error": f"bad shard name {name!r}"}
+                return {"ok": False,
+                        "error": f"bad_request: shard name {name!r}"}
             os.makedirs(d, exist_ok=True)
             tmp = os.path.join(d, name + ".tmp")
             with open(tmp, "wb") as f:
@@ -935,7 +943,8 @@ class BrokerServer:
         if t == "shard.get":
             name = str(req["name"])
             if not valid_shard_name(name):
-                return {"ok": False, "error": f"bad shard name {name!r}"}
+                return {"ok": False,
+                        "error": f"bad_request: shard name {name!r}"}
             try:
                 with open(os.path.join(d, name), "rb") as f:
                     return {"ok": True, "data": f.read()}
@@ -944,7 +953,8 @@ class BrokerServer:
         if t == "shard.drop":
             name = str(req["name"])
             if not valid_shard_name(name):
-                return {"ok": False, "error": f"bad shard name {name!r}"}
+                return {"ok": False,
+                        "error": f"bad_request: shard name {name!r}"}
             try:
                 os.remove(os.path.join(d, name))
             except OSError:

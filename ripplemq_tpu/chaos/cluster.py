@@ -145,7 +145,7 @@ class InProcCluster:
         (empty before a standby joins / in full-copy mode) — the
         nemesis's stripe-op resolution surface."""
         for b in self.brokers.values():
-            if not b._stopped:
+            if not b.stopped:
                 return tuple(b.manager.current_stripe_map())
         return ()
 
@@ -153,7 +153,7 @@ class InProcCluster:
         """Current controller broker id per any live broker's view
         (None when every broker is down)."""
         for b in self.brokers.values():
-            if not b._stopped:
+            if not b.stopped:
                 return b.manager.current_controller()
         return None
 
@@ -174,7 +174,7 @@ class InProcCluster:
 
         if self._data_dir is None:
             raise RuntimeError("disk faults need a data_dir cluster")
-        if not self.brokers[broker_id]._stopped:
+        if not self.brokers[broker_id].stopped:
             # Mirror ProcCluster's guard: damaging a store a LIVE
             # BrokerServer holds open desyncs its append position from
             # the file — later appends interleave garbage frames and the

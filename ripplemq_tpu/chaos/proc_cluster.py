@@ -119,12 +119,25 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "membership_poll_s": config.membership_poll_s,
         "group_session_timeout_s": config.group_session_timeout_s,
         "group_retention_s": config.group_retention_s,
+        "metadata_refresh_s": config.metadata_refresh_s,
         "rpc_timeout_s": config.rpc_timeout_s,
+        "controller_id": config.controller_id,
         "standby_count": config.standby_count,
         "segment_bytes": config.segment_bytes,
+        "store_retention_bytes": config.store_retention_bytes,
         "durability": config.durability,
         "replication": config.replication,
         "pid_retention_s": config.pid_retention_s,
+        # The batcher operating point and worker sizing used to be
+        # dropped here: an in-proc soak and its subprocess twin ran
+        # DIFFERENT coalesce/chain/pipeline shapes whenever a test
+        # tuned them (found by ripplelint's config_plumbing rule; the
+        # round-trip lock lives in tests/test_process_cluster.py).
+        "coalesce_s": config.coalesce_s,
+        "read_coalesce_s": config.read_coalesce_s,
+        "chain_depth": config.chain_depth,
+        "pipeline_depth": config.pipeline_depth,
+        "rpc_workers": config.rpc_workers,
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
     }

@@ -35,6 +35,25 @@ from typing import Callable, Optional
 
 _DEFAULT_CAPACITY = 4096
 
+# The CLOSED event vocabulary: every `recorder.record("<type>", ...)`
+# emit site in the library must name a member, every member must have
+# a live emit site, and every member is documented in the README
+# Observability section — all three machine-checked by ripplelint's
+# trace_vocab rule (analysis/trace_vocab.py). Timeline tooling, chaos
+# verdict readers, and postmortem walkthroughs key on these names;
+# an undocumented event is a timeline entry nobody can interpret.
+EVENT_TYPES = frozenset({
+    # Round lifecycle (per ROUND, never per message).
+    "dispatch", "commit", "settle_enter", "settle_release", "settle_fail",
+    # Data-plane control transitions.
+    "elect", "set_leader", "settled_gap", "stall_reset", "install",
+    # Broker/controller lifecycle.
+    "controller_boot", "boot_failed", "deposed", "abdicate",
+    "standby_joined", "store_quarantine", "stripe_rebuild",
+    # Consumer-group coordinator (manager applies + fencing).
+    "group_join", "group_leave", "group_delete", "fence",
+})
+
 
 class FlightRecorder:
     def __init__(self, capacity: int = _DEFAULT_CAPACITY,

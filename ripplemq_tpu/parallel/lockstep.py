@@ -322,7 +322,8 @@ class LockstepWorker:
         except Exception as e:  # report, don't kill the server thread
             log.warning("lockstep replay failed: %s: %s",
                         type(e).__name__, e)
-            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            return {"ok": False,
+                    "error": f"internal: {type(e).__name__}: {e}"}
 
     def _execute(self, method: str, args: list) -> None:
         if method == "configure":

@@ -38,11 +38,24 @@ from typing import Callable, Optional
 # request (or the cluster's configuration) is wrong, not the timing.
 # Everything else — not_leader, not_committed, unavailable, stale_epoch,
 # transport errors — is retryable by default: transient by construction.
+# COMPLETENESS is machine-checked: ripplelint's retry_taxonomy rule
+# collects every `{"ok": False, "error": <literal>}` emit site in the
+# library and requires its typed prefix to appear in exactly one of
+# these two tuples (tests/test_lint.py keeps the tree clean), so a new
+# wire error ships with a recorded retry decision instead of falling
+# through to default-retryable unreviewed (the PR 7 fenced_generation
+# lesson).
 FATAL_ERROR_PREFIXES = (
     "bad_request",
     "unknown_partition",
     "consumer_table_full",
-    "unknown request type",
+    # All the unknown-operation refusals ("unknown request ...",
+    # "unknown engine op", "unknown shard op"): the caller speaks a
+    # protocol this broker does not — resending the same frame can
+    # never start succeeding.
+    "unknown request",
+    "unknown engine op",
+    "unknown shard op",
     # Consumer-group fencing: retrying a stale-generation commit (or a
     # membership the coordinator evicted) can never succeed — the member
     # must REJOIN and act under the new generation. The group SDK maps
@@ -50,6 +63,37 @@ FATAL_ERROR_PREFIXES = (
     # would just hammer the fence.
     "fenced_generation",
     "unknown_member",
+    # Structural deployment refusals (previously unclassified, so
+    # clients burned their full attempt/deadline budget against them):
+    # a broker launched without a data_dir/store never grows one within
+    # an operation's budget, and a shard/snapshot a peer does not hold
+    # will not appear by asking the same peer again — callers that can
+    # try ANOTHER broker do so at their own layer.
+    "no_store",
+    "no_data_dir",
+    "not_found",
+    # Lockstep sequence desync: the worker refuses every replay at the
+    # broken seq until the plane is rebuilt — re-sending is a tight
+    # error loop, not a recovery.
+    "lockstep break",
+)
+
+# Known-retryable prefixes (transient by construction). This tuple is
+# documentation-with-teeth: `fatal_response_error` treats anything
+# non-fatal as retryable either way, but the lint rule above requires
+# every emitted error to be NAMED here or in FATAL_ERROR_PREFIXES, so
+# "retryable" is always a decision someone made, never a fall-through.
+RETRYABLE_ERROR_PREFIXES = (
+    "not_committed",        # commit raced/refused; the round may land
+    "not_leader",           # follow the hint, retry
+    "not_controller",       # controllership moving; metadata will heal
+    "unavailable",          # quorum-degraded fast-fail (PR 2)
+    "stale_epoch",          # fencing during handover; next epoch serves
+    "active_controller",    # replication fence while a handover settles
+    "store_quarantined",    # standby refuses acks until re-admitted
+    "bad_stripe_frame",     # wire corruption: the re-send re-encodes
+    "consumer_registration_failed",  # metadata round raced; re-propose
+    "internal",             # unexpected exception; timing-dependent
 )
 
 

@@ -39,6 +39,21 @@ def test_append_commits_and_assigns_offsets(dp):
     assert dp.commit_index(0) in (8, 16)
 
 
+def test_log_end_locked_accessor(dp):
+    """ISSUE 10 (ripplelint lock_discipline): external pollers read the
+    host-shadow log end through the locked accessor — profiles/
+    host_edge.py reached into `dp._log_end` bare before the lint pass.
+    The accessor tracks the settled advance and never requires callers
+    to touch the plane's lock."""
+    assert dp.log_end(0) == 0
+    dp.set_leader(0, 0, 1)
+    dp.submit_append(0, [b"a", b"b"]).result(timeout=10)
+    end = dp.log_end(0)
+    assert end >= 2  # ALIGN-padded round: at least the two records
+    with dp._lock:  # white-box: the accessor mirrors the shadow exactly
+        assert end == int(dp._log_end[0])
+
+
 def test_many_submitters_coalesce_into_rounds(dp):
     dp.set_leader(1, 2, 1)
     futs = [dp.submit_append(1, [f"m{i}".encode()]) for i in range(50)]

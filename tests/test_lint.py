@@ -1,0 +1,478 @@
+"""ripplelint tier-1 gate: the tree is clean, and every checker still
+catches the regression class it was built from.
+
+Two halves:
+
+- **Fixture tests** — one seeded failing snippet per rule, run through
+  the checker's PURE core (`ast.parse(snippet)`), proving the rule
+  would catch its motivating bug if it were reintroduced. Each fixture
+  is the review finding that motivated the rule, reduced.
+- **Whole-tree assertions** — `run_lint()` reports zero unwaived
+  findings and zero stale waivers on the actual repo (the clean-tree
+  contract ISSUE 10 ships with), the ledger is well-formed (every
+  waiver has a reason), and the JSON verdict carries per-checker
+  counts + runtime inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import textwrap
+
+import pytest
+
+from ripplemq_tpu.analysis import (
+    CHECKERS,
+    LedgerError,
+    Repo,
+    Waiver,
+    config_plumbing,
+    determinism,
+    lock_discipline,
+    markers,
+    retry_taxonomy,
+    run_lint,
+    shard_shapes,
+    stats_schema,
+    trace_vocab,
+)
+from ripplemq_tpu.analysis.framework import validate_ledger
+from ripplemq_tpu.analysis.ledger import WAIVERS
+
+
+def _parse(src: str) -> ast.AST:
+    return ast.parse(textwrap.dedent(src))
+
+
+# ===================================================== per-rule fixtures
+
+# ---- lock_discipline: the PR 4 `_settled_end` bare-read class --------
+
+GUARDED_SRC = """
+    import threading
+
+    class Plane:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._settled = [0]
+            self._boring = 1
+
+        def settled(self, slot):
+            with self._lock:
+                return self._settled[slot]
+
+        def _merge_locked(self, slot):
+            self._gaps[slot] = 1
+"""
+
+
+def test_lock_guard_inference():
+    g = lock_discipline.guarded_fields(_parse(GUARDED_SRC))
+    # Fields under the lock (and in *_locked methods) are guarded;
+    # plain attributes and the lock itself are not.
+    assert g == {"Plane": {"_settled", "_gaps"}}
+
+
+def test_lock_bare_read_fixture_caught():
+    # The seeded regression: an admin surface reaching into the plane's
+    # guarded array bare (the exact shape broker/server.py once had).
+    reader = _parse("""
+        def stats(dp):
+            return {"end": dp._settled[0]}
+    """)
+    guarded = {"Plane": {"_settled"}}
+    found = lock_discipline.bare_reads("mod.py", reader, guarded)
+    assert len(found) == 1
+    assert found[0].key == "mod.py::stats::_settled"
+    # Same read through a module that OWNS a _settled field of its own
+    # class: not a cross-class reach-in, not flagged.
+    owner = _parse("""
+        class Other:
+            def __init__(self):
+                self._settled = []
+        def stats(dp):
+            return {"end": dp._settled[0]}
+    """)
+    assert lock_discipline.bare_reads("mod.py", owner, guarded) == []
+
+
+def test_lock_blocking_call_fixture_caught():
+    # The PR 9 review class: blocking work under the ack-path lock.
+    src = _parse("""
+        import time
+
+        class Plane:
+            def wait(self, fut):
+                with self._lock:
+                    fut.result(timeout=1.0)
+            def pause(self):
+                with self._lock:
+                    time.sleep(0.1)
+            def fine(self):
+                with self._lock:
+                    self._cond.wait(0.1)   # releases the lock: exempt
+            def also_fine(self, fut):
+                fut.result(timeout=1.0)    # no lock held
+    """)
+    found = lock_discipline.blocking_under_lock("mod.py", src)
+    assert {f.key for f in found} == {
+        "mod.py::wait::result", "mod.py::pause::sleep",
+    }
+
+
+def test_lock_closure_under_lock_not_flagged():
+    # A closure DEFINED under the lock runs later, outside it.
+    src = _parse("""
+        import time
+        class P:
+            def go(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1)
+                    self._cb = later
+    """)
+    assert lock_discipline.blocking_under_lock("m.py", src) == []
+
+
+# ---- config_plumbing: the silently-dropped proc field class ----------
+
+CONFIG_SRC = """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class ClusterConfig:
+        brokers: tuple
+        rpc_timeout_s: float = 3.0
+        shiny_new_knob_s: float = 1.0
+"""
+
+
+def test_config_field_extraction():
+    fields = config_plumbing.config_fields(_parse(CONFIG_SRC))
+    assert fields == ["brokers", "rpc_timeout_s", "shiny_new_knob_s"]
+
+
+def test_config_missing_field_fixture_caught():
+    # The seeded regression: a new knob parsed from YAML but absent
+    # from the proc-cluster serialization (exactly how coalesce_s/
+    # chain_depth/... shipped before this PR).
+    proc_fn = _parse("""
+        def _config_yaml_dict(config):
+            return {
+                "brokers": [],
+                "rpc_timeout_s": config.rpc_timeout_s,
+            }
+    """).body[0]
+    fields = config_plumbing.config_fields(_parse(CONFIG_SRC))
+    reached = config_plumbing.names_reached(proc_fn)
+    found = config_plumbing.missing_fields(fields, reached, "proc", "p.py")
+    assert [f.key for f in found] == ["proc::shiny_new_knob_s"]
+
+
+# ---- retry_taxonomy: the unclassified fenced_generation class --------
+
+
+def test_retry_emit_extraction_and_classification():
+    src = _parse("""
+        def handle(req):
+            if bad(req):
+                return {"ok": False, "error": "shiny_refusal: nope"}
+            if worse(req):
+                return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            return {"ok": True, "error": "not an emit (ok True)"}
+    """)
+    emits = retry_taxonomy.error_emits(src)
+    assert len(emits) == 2
+    prefixes = [p for _, p, _ in emits]
+    assert "shiny_refusal" in prefixes
+    assert None in prefixes  # the untyped f-string
+    # Untyped findings are keyed by enclosing scope, not line numbers.
+    assert all(scope == "handle" for _, _, scope in emits)
+    fatal, retryable = ("bad_request",), ("not_committed",)
+    assert retry_taxonomy.classify("shiny_refusal", fatal, retryable) is None
+    assert retry_taxonomy.classify("bad_request", fatal, retryable) == "fatal"
+    assert retry_taxonomy.classify(
+        "not_committed", fatal, retryable) == "retryable"
+
+
+def test_retry_taxonomy_parses_live_tuples():
+    repo = Repo()
+    fatal, retryable = retry_taxonomy.taxonomy(
+        repo.tree(retry_taxonomy.RETRY_PATH))
+    assert "bad_request" in fatal and "no_store" in fatal
+    assert "not_committed" in retryable and "bad_stripe_frame" in retryable
+
+
+# ---- determinism: the wall-clock-in-pure-machinery class -------------
+
+
+def test_determinism_fixture_caught():
+    src = _parse("""
+        import time, random, os
+
+        def _apply_set_leader(self, cmd):
+            stamp = time.time()            # forks replicas
+            pick = random.choice(cmd)      # unseeded
+            salt = hash(cmd["k"])          # process-unstable (PR 4)
+            return stamp, pick, salt
+    """)
+    found = determinism.scope_findings("m.py", src, r"^_apply_")
+    assert {f.key.rsplit("::", 1)[-1] for f in found} == {
+        "time.time", "random.choice", "hash",
+    }
+
+
+def test_determinism_sanctioned_idioms_pass():
+    src = _parse("""
+        import time, random
+
+        def make_schedule(seed):
+            rng = random.Random(seed)      # seeded: fine
+            clock = time.monotonic         # stored, not called: fine
+            return rng.random(), clock
+    """)
+    assert determinism.scope_findings("m.py", src, r".*") == []
+
+
+# ---- shard_shapes: the global-P-allocation-under-shard_map class -----
+
+STEP_FIXTURE = """
+    import jax.numpy as jnp
+
+    def smapped_body(cfg, inp, quorum=None):
+        P = cfg.partitions
+        bad = jnp.zeros((P,), jnp.int32)            # global-P: caught
+        if quorum is None:
+            quorum = jnp.full((cfg.partitions,), 3)  # documented idiom
+        return bad + quorum
+
+    def host_side(cfg):
+        return jnp.zeros((cfg.partitions,))          # not smapped: fine
+"""
+
+
+def test_shard_shape_fixture_caught():
+    found = shard_shapes.alloc_findings(
+        _parse(STEP_FIXTURE), {"smapped_body"}, path="step.py")
+    assert [f.key for f in found] == ["step.py::smapped_body::zeros"]
+
+
+def test_shard_shape_derivation_matches_engine():
+    # The smapped set is derived, not hand-listed: the fused/legacy
+    # control and vote fns plus the read path must all be present.
+    repo = Repo()
+    smapped = shard_shapes.smapped_step_fns(
+        repo.tree(shard_shapes.ENGINE_PATH))
+    assert {"replica_control", "replica_control_fused",
+            "vote_step", "vote_step_fused", "read_batch"} <= smapped
+
+
+# ---- stats_schema: the silently-widened-schema class -----------------
+
+
+def test_stats_dict_flow_required_vs_optional():
+    fn = _parse("""
+        def _handle_stats(self, req):
+            stats = {"ok": True, "broker": 1}
+            if self.engine is None:
+                stats["engine"] = None
+            else:
+                engine = {"rounds": 2}
+                engine["degraded"] = False
+                if req.get("slots"):
+                    engine["slots"] = {}
+                stats["engine"] = engine
+            return stats
+    """).body[0]
+    req, opt = stats_schema.dict_flow(fn, "stats")
+    assert req == {"ok", "broker", "engine"} and opt == set()
+    ereq, eopt = stats_schema.dict_flow(fn, "engine")
+    assert ereq == {"rounds", "degraded"} and eopt == {"slots"}
+
+
+def test_stats_schema_fixture_caught(tmp_path):
+    """The seeded regression: a new stats key emitted but undocumented
+    in the README schema section — the silent-schema-widening class the
+    hand-maintained lock could not see until a human updated it."""
+    (tmp_path / "ripplemq_tpu/broker").mkdir(parents=True)
+    (tmp_path / "ripplemq_tpu/groups").mkdir(parents=True)
+    (tmp_path / stats_schema.SERVER_PATH).write_text(textwrap.dedent("""
+        class BrokerServer:
+            def _handle_stats(self, req):
+                stats = {"ok": True, "rogue_stat": 1}
+                engine = {"rounds": 2}
+                stats["engine"] = engine
+                return stats
+    """))
+    (tmp_path / stats_schema.DATAPLANE_PATH).write_text(textwrap.dedent("""
+        class DataPlane:
+            def settle_stats(self):
+                return {"window": 1}
+    """))
+    (tmp_path / stats_schema.GROUPS_PATH).write_text(textwrap.dedent("""
+        class GroupTable:
+            def summary(self):
+                return {n: {"generation": s} for n, s in self.g.items()}
+    """))
+    (tmp_path / "README.md").write_text(
+        f"{stats_schema.README_HEADING}\n\n"
+        f"`ok`, `engine`, `rounds`, `window`, `generation`\n")
+    keys = {f.key for f in stats_schema.check(Repo(tmp_path))}
+    # The addition half: emitted but undocumented.
+    assert "readme::top::rogue_stat" in keys
+    # The REMOVAL half: this synthetic handler dropped almost every
+    # baseline key — each deletion is its own finding (the guard the
+    # old hand-maintained lock provided, now in the checker).
+    assert "removed::top::broker" in keys
+    assert "removed::engine::dispatches" in keys
+
+
+def test_stats_schema_derivation_matches_live_emitters():
+    schema = stats_schema.derive_schema()
+    assert "stripe_mode" in schema.top and "ok" in schema.top
+    assert "pid_table_size" in schema.engine
+    assert schema.engine_optional == {"slots"}
+    assert schema.settle == {"window", "occupancy_mean", "samples",
+                             "backpressure_waits"}
+    assert schema.group == {"generation", "members", "partitions"}
+
+
+# ---- trace_vocab: the undocumented-event class -----------------------
+
+
+def test_trace_emit_extraction():
+    src = _parse("""
+        class X:
+            def go(self):
+                self.recorder.record("rogue_event", a=1)
+                self.history.record(op="produce", v=2)  # keyword-only: history
+    """)
+    emits = trace_vocab.emit_sites(src)
+    assert [(n) for _, n in emits] == ["rogue_event"]
+
+
+def test_trace_vocab_fixture_caught(tmp_path):
+    """The seeded regression (PR 9's actual drift): an event emitted
+    with no vocabulary entry — and, symmetrically, a vocabulary entry
+    whose emit site was renamed away."""
+    (tmp_path / "ripplemq_tpu/obs").mkdir(parents=True)
+    (tmp_path / "ripplemq_tpu/broker").mkdir(parents=True)
+    (tmp_path / trace_vocab.TRACE_PATH).write_text(
+        'EVENT_TYPES = frozenset({"dispatch", "renamed_away"})\n')
+    (tmp_path / "ripplemq_tpu/broker/server.py").write_text(
+        textwrap.dedent("""
+            class S:
+                def go(self):
+                    self.recorder.record("dispatch", n=1)
+                    self.recorder.record("rogue_event", n=2)
+        """))
+    (tmp_path / "README.md").write_text(
+        f"{trace_vocab.README_HEADING}\n\n`dispatch` `renamed_away`\n")
+    keys = {f.key for f in trace_vocab.check(Repo(tmp_path))}
+    assert keys == {"undocumented::rogue_event", "dead::renamed_away"}
+
+
+def test_trace_vocab_parses_live_set():
+    repo = Repo()
+    vocab = trace_vocab.vocabulary(repo.tree(trace_vocab.TRACE_PATH))
+    # The PR 9 drift this rule was built from: stripe_rebuild emitted
+    # but undocumented; it is now both in the vocabulary and README.
+    assert "stripe_rebuild" in vocab and "dispatch" in vocab
+
+
+# ---- markers: the unmarked-soak class --------------------------------
+
+
+def test_marker_fixture_caught(tmp_path):
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    (tests / "test_rogue_soak.py").write_text("def test_x():\n    pass\n")
+    for name in markers.PINNED_SLOW:
+        (tests / f"{name}.py").write_text(
+            "import pytest\npytestmark = pytest.mark.slow\n")
+    found = markers.check(Repo(tmp_path))
+    assert any(f.key == "unvetted::test_rogue_soak" for f in found)
+    # Marking it slow clears that finding.
+    (tests / "test_rogue_soak.py").write_text(
+        "import pytest\npytestmark = pytest.mark.slow\ndef test_x():\n"
+        "    pass\n")
+    found = markers.check(Repo(tmp_path))
+    assert not any(f.key == "unvetted::test_rogue_soak" for f in found)
+
+
+def test_marker_slow_detection():
+    assert markers.is_slow_marked(_parse(
+        "import pytest\npytestmark = pytest.mark.slow\n"))
+    assert markers.is_slow_marked(_parse(
+        "import pytest\npytestmark = [pytest.mark.slow, pytest.mark.x]\n"))
+    assert not markers.is_slow_marked(_parse("x = 1\n"))
+
+
+# ===================================================== whole-tree gates
+
+
+def test_ledger_wellformed():
+    # Every waiver names a known rule and carries a real reason.
+    validate_ledger(WAIVERS, CHECKERS.keys())
+    for w in WAIVERS:
+        assert len(w.reason.strip()) > 20, (
+            f"waiver {w.rule}:{w.key}: a reason must actually explain "
+            f"why the finding is deliberate"
+        )
+
+
+def test_ledger_rejects_empty_reason():
+    with pytest.raises(LedgerError):
+        validate_ledger([Waiver("markers", "k", "  ")], CHECKERS.keys())
+    with pytest.raises(LedgerError):
+        validate_ledger([Waiver("not_a_rule", "k", "reason enough")],
+                        CHECKERS.keys())
+
+
+def test_unmatched_waiver_is_stale():
+    report = run_lint(rules=["markers"], waivers=[
+        Waiver("markers", "unvetted::no_such_module",
+               "stale on purpose for this test"),
+    ])
+    assert not report["ok"]
+    assert report["stale_waivers"][0]["key"] == "unvetted::no_such_module"
+
+
+def test_tree_is_clean():
+    """THE gate: zero unwaived findings, zero stale waivers, on the
+    real tree with the real ledger."""
+    report = run_lint()
+    dirty = {
+        rule: c["findings"]
+        for rule, c in report["checkers"].items() if c["findings"]
+    }
+    assert report["ok"], (
+        f"ripplelint dirty: {json.dumps(dirty, indent=2)[:4000]}\n"
+        f"stale: {report['stale_waivers']}"
+    )
+    # All the advertised rules ran.
+    assert set(report["checkers"]) == set(CHECKERS)
+    assert len(CHECKERS) >= 7
+
+
+def test_json_verdict_shape_and_budget():
+    """The CI surface: per-checker counts + runtimes, JSON-encodable,
+    and the whole-tree run fits far inside the tier-1 budget (it is
+    AST-only — no imports of checked modules, no device)."""
+    report = run_lint()
+    json.loads(json.dumps(report))  # wire-encodable, no exotic types
+    for rule, c in report["checkers"].items():
+        assert {"count", "findings", "waived", "runtime_s"} <= set(c)
+        assert c["runtime_s"] >= 0.0
+    assert report["runtime_s"] < 60.0, (
+        f"lint took {report['runtime_s']}s — it must stay a rounding "
+        f"error inside the 870 s tier-1 budget"
+    )
+
+
+def test_single_rule_selection():
+    report = run_lint(rules=["trace_vocab"])
+    assert set(report["checkers"]) == {"trace_vocab"}
+    with pytest.raises(KeyError):
+        run_lint(rules=["nonsense"])

@@ -1,137 +1,40 @@
-"""Tier-1 runtime-budget audit: the sustained/soak benches must never
-creep back into the default test selection.
+"""Tier-1 runtime-budget audit — now a thin wrapper over ripplelint.
 
-ROADMAP.md's tier-1 command runs `-m 'not slow'` under a hard timeout on
-a small CPU host. That budget only holds if every module in the default
-selection stays fast; one unmarked soak (measured: the cross-process
-lockstep drill alone burns up to 6 minutes) times the whole tier out —
-which is exactly how the seed's tier-1 went red. This audit pins the
-contract STATICALLY, so adding a heavy module without either a `slow`
-mark or a conscious allowlist entry fails tier-1 immediately instead of
-intermittently:
-
-- every `tests/test_*.py` module must either carry a module-level
-  `pytestmark = pytest.mark.slow` (long-running: soaks, cross-process
-  meshes, drills) or appear in FAST_MODULES, the curated list of
-  modules consciously admitted to the tier-1 budget. The audit
-  enforces MEMBERSHIP, not runtime — admission is the review point:
-  most entries run <30 s on the CPU backend, the heaviest admitted
-  entries are annotated with their measured cost, and the whole
-  selection must keep fitting the 870 s tier (currently ~510 s);
-- a module in FAST_MODULES must NOT also be slow-marked (a stale
-  allowlist entry would silently shrink tier-1 coverage).
+The slow-marker contract (every test module either slow-marked or
+consciously admitted to the tier-1 budget; no stale or double-marked
+allowlist entries; the known soaks keep their marks) moved into the
+static-analysis plane as the `markers` rule
+(`ripplemq_tpu/analysis/markers.py` — FAST_MODULES lives there now, so
+the lint CLI and this audit can never disagree). This module keeps the
+original test names as a direct, fast tier-1 surface: a marker-contract
+violation fails here with the checker's own message, same as it fails
+`profiles/lint.py` and `tests/test_lint.py::test_tree_is_clean`.
 """
 
 from __future__ import annotations
 
-import ast
-import pathlib
+from ripplemq_tpu.analysis import Repo, markers
 
-TESTS_DIR = pathlib.Path(__file__).parent
-
-# Modules vetted fast on the CPU backend (per-module timings recorded
-# while repairing the seed's tier-1 timeout). Annotate anything over
-# ~15 s so the next budget squeeze knows where the time goes.
-FAST_MODULES = {
-    "test_append_kernel",      # ~2 min: Mosaic-interpreter kernel parity
-    "test_broker",
-    "test_chain",
-    "test_chaos",               # ~20 s: fixed-seed chaos smoke (3 seeds)
-    "test_client",
-    "test_cold_restart",
-    "test_control_fusion",
-    "test_controller_failover",
-    "test_core_step",
-    "test_dataplane",
-    "test_degradation",
-    "test_failover",
-    "test_graft",
-    "test_groups",              # ~30 s: coordinator units + one cluster run
-    "test_hostraft",
-    "test_idempotence",         # ~25 s: dedup units + failover replay
-    "test_linearizable_reads",  # ~25 s: staged stale-controller clusters
-    "test_log_matching",
-    "test_marker_audit",
-    "test_metadata",
-    "test_model_check",
-    "test_multichip_smoke",     # tier-1 fused-spmd canary on the 8-dev mesh
-    "test_observability",
-    "test_op_split",
-    "test_packaging",
-    "test_pid_expiry",          # ~10 s: reaper units + one churn cluster
-    "test_proc_chaos",          # ~2 min: 2-seed real-subprocess chaos smoke
-    "test_process_cluster",     # ~20 s: real-subprocess broker boot
-    "test_read_batching",
-    "test_read_cache",
-    "test_readme_bench",
-    "test_settle_pipeline",
-    "test_settled_gap",
-    "test_term_skew",
-    "test_retention",
-    "test_retry_policy",
-    "test_rs",
-    "test_shard_distribution",
-    "test_soak",                # ~15 s: the bounded hand-written soak
-    "test_spmd",
-    "test_storage",
-    "test_store_gc",            # ~17 s: GC/retention store churn
-    "test_stripes",             # ~30 s: any-k matrix + 3 striped clusters
-    "test_store_migrate",
-    "test_stride_rule",
-    "test_wire",
-}
+# Re-exported for any historical reader of the audit module; the
+# canonical definition is the checker's.
+FAST_MODULES = markers.FAST_MODULES
 
 
-def _is_slow_marked(path: pathlib.Path) -> bool:
-    """True iff the module carries a top-level slow pytestmark
-    (`pytestmark = pytest.mark.slow` or a list containing it)."""
-    tree = ast.parse(path.read_text())
-    for node in tree.body:
-        if not isinstance(node, ast.Assign):
-            continue
-        if not any(isinstance(t, ast.Name) and t.id == "pytestmark"
-                   for t in node.targets):
-            continue
-        if "slow" in ast.dump(node.value):
-            return True
-    return False
-
-
-def _modules():
-    return sorted(TESTS_DIR.glob("test_*.py"))
+def _findings(prefixes: tuple[str, ...]) -> list[str]:
+    found = markers.check(Repo())
+    return [f"{f.key}: {f.message}" for f in found
+            if f.key.startswith(prefixes)]
 
 
 def test_every_module_fast_or_slow_marked():
-    offenders = []
-    for path in _modules():
-        name = path.stem
-        if name in FAST_MODULES or _is_slow_marked(path):
-            continue
-        offenders.append(name)
-    assert not offenders, (
-        f"test modules neither slow-marked nor vetted fast: {offenders}. "
-        "Mark them `pytestmark = pytest.mark.slow` (soaks/drills) or vet "
-        "them under ~30 s on CPU and add them to FAST_MODULES."
-    )
+    assert not _findings(("unvetted::",))
 
 
 def test_allowlist_entries_exist_and_are_not_slow():
-    names = {p.stem for p in _modules()}
-    stale = FAST_MODULES - names
-    assert not stale, f"FAST_MODULES entries without a module: {stale}"
-    double = [p.stem for p in _modules()
-              if p.stem in FAST_MODULES and _is_slow_marked(p)]
-    assert not double, (
-        f"modules both allowlisted and slow-marked: {double} — drop one "
-        "(a stale allowlist entry hides shrinking tier-1 coverage)"
-    )
+    assert not _findings(("stale::", "double::"))
 
 
 def test_known_soaks_stay_slow_marked():
     """The modules that took the seed's tier-1 over its timeout must
     keep their marks (deleting a mark reintroduces the timeout)."""
-    for name in ("test_multihost", "test_soak_random", "test_soak_gc",
-                 "test_lockstep_drill", "test_chaos_soak",
-                 "test_proc_chaos_soak", "test_obs_soak"):
-        path = TESTS_DIR / f"{name}.py"
-        assert _is_slow_marked(path), f"{name} lost its slow mark"
+    assert not _findings(("pinned::", "pinned-gone::"))

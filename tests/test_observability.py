@@ -214,37 +214,23 @@ def test_membership_poll_gates_liveness_reaction():
 
 # ===================================================== telemetry plane (obs/)
 
-# The admin.stats SCHEMA LOCK: every field profiles/bench/operators
-# consume, pinned as an exact key set so a refactor cannot silently drop
-# one (README "Observability" documents each). Adding a field means
-# extending these sets AND the README table — that review step is the
-# point.
-STATS_TOP_KEYS = {
-    "ok", "broker", "address", "boot_failures", "store_quarantined",
-    "metadata", "controller", "topics", "live", "duty_errors",
-    "erasure_errors", "engine",
-    # ISSUE 7: consumer groups (per-group generation + membership),
-    # the idempotent-producer registry size, and recycled consumer
-    # slots awaiting their offset reset.
-    "groups", "producer_ids", "dirty_consumer_slots",
-    # ISSUE 9: the striped-replication surface — active plane
-    # ("full"|"striped"), the replicated stripe→member assignment
-    # (stripe i held by stripe_holders[i]; empty before a standby
-    # joins or in full-copy mode), and how many any-k promotion
-    # rebuilds this process ran.
-    "stripe_mode", "stripe_holders", "stripe_rebuilds",
-}
-STATS_ENGINE_KEYS = {
-    "mode", "rounds", "dispatches", "read_queries", "read_dispatches",
-    "read_cache_hits", "mirror_gap_slots", "settled_gap_slots",
-    "stalled_slots", "committed_entries", "step_errors", "settle",
-    "partitions", "degraded_slots", "degraded",
-    # ISSUE 7: producer-dedup table occupancy ((pid, partition) keys).
-    "pid_table_size",
-}
-STATS_SETTLE_KEYS = {"window", "occupancy_mean", "samples",
-                     "backpressure_waits"}
-STATS_GROUP_KEYS = {"generation", "members", "partitions"}
+# The admin.stats SCHEMA LOCK, ISSUE 10 edition: the expected key sets
+# are DERIVED from the emit sites (ripplelint's stats_schema rule —
+# analysis/stats_schema.py walks _handle_stats, settle_stats, and the
+# group summary ASTs), not hand-maintained here. The division of labor:
+# lint fails any emitted key that is undocumented in the README schema
+# section (so a new field is a deliberate two-surface change), and THIS
+# test asserts the LIVE RPC response matches the derived sets exactly
+# (so a dynamically-added key the AST cannot see — or a key emitted
+# only on some branch — still fails tier-1 instead of silently widening
+# the schema).
+from ripplemq_tpu.analysis.stats_schema import derive_schema
+
+_SCHEMA = derive_schema()
+STATS_TOP_KEYS = set(_SCHEMA.top)
+STATS_ENGINE_KEYS = set(_SCHEMA.engine)
+STATS_SETTLE_KEYS = set(_SCHEMA.settle)
+STATS_GROUP_KEYS = set(_SCHEMA.group)
 
 
 def test_admin_stats_schema_lock():

@@ -113,6 +113,73 @@ def test_three_process_tcp_roundtrip(process_cluster):
     assert "consumed from topic2: b'Message 1'" in out.stdout, out.stdout
 
 
+def test_config_yaml_dict_round_trips_every_field():
+    """ISSUE 10 (ripplelint config_plumbing), directed failing-before
+    test: `_config_yaml_dict` silently DROPPED coalesce_s /
+    read_coalesce_s / chain_depth / pipeline_depth / rpc_workers /
+    controller_id / metadata_refresh_s / store_retention_bytes — a
+    proc-cluster chaos run launched subprocess brokers with the
+    DEFAULTS for all of them, so an in-proc soak and its subprocess
+    twin ran different operating points whenever a test tuned one.
+    Every ClusterConfig field must survive serialize → YAML → parse."""
+    import dataclasses
+
+    import yaml
+
+    from ripplemq_tpu.chaos.proc_cluster import _config_yaml_dict
+    from ripplemq_tpu.core.config import EngineConfig
+    from ripplemq_tpu.metadata.cluster_config import (
+        ClusterConfig,
+        parse_cluster_config,
+    )
+    from ripplemq_tpu.metadata.models import BrokerInfo, Topic
+
+    config = ClusterConfig(
+        brokers=(BrokerInfo(0, "127.0.0.1", 9101),
+                 BrokerInfo(1, "127.0.0.1", 9102)),
+        topics=(Topic("t", 2, 2),),
+        engine=EngineConfig(partitions=2, replicas=2, slots=64,
+                            slot_bytes=64, max_batch=8, read_batch=8,
+                            max_consumers=8, max_offset_updates=4),
+        # Every scalar deliberately NON-default so a dropped field
+        # cannot hide behind its default on the parse side.
+        election_timeout_s=0.7,
+        metadata_election_timeout_s=1.3,
+        membership_poll_s=0.9,
+        group_session_timeout_s=2.2,
+        group_retention_s=33.0,
+        metadata_refresh_s=4.5,
+        rpc_timeout_s=6.0,
+        controller_id=1,
+        standby_count=1,
+        replication="striped",
+        pid_retention_s=120.0,
+        segment_bytes=1 << 16,
+        store_retention_bytes=2 << 16,
+        coalesce_s=0.004,
+        chain_depth=2,
+        pipeline_depth=3,
+        read_coalesce_s=0.002,
+        linearizable_reads=True,
+        durability="strict",
+        obs=False,
+        rpc_workers=7,
+    )
+    raw = yaml.safe_load(yaml.safe_dump(_config_yaml_dict(config)))
+    parsed = parse_cluster_config(raw)
+    for f in dataclasses.fields(ClusterConfig):
+        if f.name == "engine":
+            continue  # engine shape fields are compared below
+        assert getattr(parsed, f.name) == getattr(config, f.name), (
+            f"ClusterConfig.{f.name} lost in the proc-cluster "
+            f"serialization round trip"
+        )
+    for name in ("partitions", "replicas", "slots", "slot_bytes",
+                 "max_batch", "read_batch", "max_consumers",
+                 "max_offset_updates", "settle_window"):
+        assert getattr(parsed.engine, name) == getattr(config.engine, name)
+
+
 def test_cli_rejects_bad_config(tmp_path):
     bad = tmp_path / "bad.yaml"
     bad.write_text("brokers: []\n")

@@ -108,6 +108,63 @@ def test_fatal_taxonomy():
     assert not fatal_response_error("stale_epoch")
 
 
+def test_structural_refusals_are_fatal():
+    """ISSUE 10 (ripplelint retry_taxonomy): the structural deployment
+    refusals shipped UNCLASSIFIED — clients burned their whole attempt/
+    deadline budget against a broker that will never grow a store or a
+    data dir within the operation's lifetime. Failing-before: every
+    assertion in the first block was False."""
+    assert fatal_response_error("no_store")
+    assert fatal_response_error("no_data_dir")
+    assert fatal_response_error("not_found")
+    assert fatal_response_error("unknown engine op 'x'")
+    assert fatal_response_error("unknown shard op 'y'")
+    assert fatal_response_error("unknown request type 'z'")
+    assert fatal_response_error("lockstep break: got seq 3, expected 2")
+    # And the explicitly-retryable side stays retryable: transient by
+    # construction, named in RETRYABLE_ERROR_PREFIXES (lint enforces
+    # that every emitted prefix is in exactly one tuple).
+    for err in ("bad_stripe_frame", "store_quarantined",
+                "active_controller", "not_controller",
+                "consumer_registration_failed", "internal: KeyError: x"):
+        assert not fatal_response_error(err), err
+
+
+def test_consume_fails_fast_on_no_store():
+    """Directed failing-before test for the no_store classification: a
+    consume answered with the structural refusal must surface after ONE
+    attempt — before the fix the client retried max_attempts times with
+    full backoff sleeps against a broker that can never serve."""
+    net = InProcNetwork()
+    handler, brokers = _meta_handler()
+    calls = {"consume": 0}
+
+    def broker0(req):
+        if req.get("type") == "consume":
+            calls["consume"] += 1
+            return {"ok": False, "error": "no_store"}
+        return handler(req)
+
+    net.register(brokers[0].address, broker0)
+    clock = FakeClock()
+    policy = make_policy(clock, max_attempts=5, base_backoff_s=0.1,
+                         max_backoff_s=1.0)
+    consumer = ConsumerClient(
+        [brokers[0].address], "c1",
+        transport=net.client("consumer"),
+        retry_policy=policy,
+        metadata_refresh_s=3600,
+    )
+    try:
+        with pytest.raises(ConsumeError) as ei:
+            consumer.consume("t", partition=0)
+        assert "no_store" in str(ei.value)
+        assert calls["consume"] == 1, "retried a structural refusal"
+        assert clock.sleeps == []
+    finally:
+        consumer.close()
+
+
 # ------------------------------------------------- clients route through it
 
 def _meta_handler(n_brokers=2):
