@@ -71,6 +71,13 @@ def main() -> int:
                          "(nemesis fault ops + every broker's flight-"
                          "recorder events, sorted by wall clock) even on "
                          "clean runs; violating runs always carry it")
+    ap.add_argument("--witness", action="store_true",
+                    help="enable the runtime lock witness for the run "
+                         "(in-proc backend): the verdict gains a "
+                         "lock_witness section, and a witnessed "
+                         "acquisition cycle or an edge outside the "
+                         "static lock graph's closure "
+                         "(analysis/lock_graph.py) is a violation")
     ap.add_argument("--postmortems", action="store_true",
                     help="attach per-broker admin.postmortem bundles even "
                          "on clean runs; violating runs always carry them")
@@ -127,6 +134,7 @@ def main() -> int:
             replication_mode=args.replication,
             include_timeline=args.timeline,
             include_postmortems=args.postmortems,
+            lock_witness=args.witness,
             # Process boots (JAX import + XLA compiles per broker) put
             # convergence probes on a different clock than in-proc runs.
             converge_timeout_s=120.0 if args.backend == "proc" else 30.0,

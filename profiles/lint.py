@@ -9,10 +9,12 @@
 Exit status 0 iff the tree is clean: zero unwaived findings AND zero
 stale waivers (a suppression that stopped matching is coverage rot and
 fails just like a finding). The JSON verdict carries per-checker
-finding counts and runtimes so CI can budget the lint wall-time
-against the tier-1 870 s ceiling (whole-tree runs measure ~2-3 s on
-the 2-core build host — it is AST parsing, no imports of the checked
-modules, no device).
+finding counts and runtimes — all 11 rules, including the concurrency
+plane (`threads` / `lock_graph` / `ownership`, which share ONE cached
+repo call-graph closure per run via `Repo.cache`) — so CI can budget
+the lint wall-time against the tier-1 870 s ceiling (whole-tree runs
+measure ~4 s on the 2-core build host — AST parsing only, no imports
+of the checked modules, no device).
 
 Waiving a finding: add a `(rule, key, reason)` entry to
 `ripplemq_tpu/analysis/ledger.py` — the key is printed with every

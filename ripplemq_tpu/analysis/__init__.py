@@ -8,10 +8,13 @@ from ripplemq_tpu.analysis import (  # noqa: F401
     config_plumbing,
     determinism,
     lock_discipline,
+    lock_graph,
     markers,
+    ownership,
     retry_taxonomy,
     shard_shapes,
     stats_schema,
+    threads,
     trace_vocab,
 )
 from ripplemq_tpu.analysis.framework import (  # noqa: F401
@@ -31,4 +34,9 @@ CHECKERS = {
     stats_schema.RULE: stats_schema.check,
     trace_vocab.RULE: trace_vocab.check,
     markers.RULE: markers.check,
+    # Concurrency plane (PR 11): thread inventory feeds ownership, and
+    # all three share the cached repo call graph (analysis/callgraph).
+    threads.RULE: threads.check,
+    lock_graph.RULE: lock_graph.check,
+    ownership.RULE: ownership.check,
 }

@@ -84,6 +84,10 @@ class Repo:
         self.root = pathlib.Path(root) if root is not None else REPO_ROOT
         self._texts: dict[str, str] = {}
         self._trees: dict[str, ast.AST] = {}
+        # Cross-checker scratch: expensive derived artifacts (the repo
+        # call graph, the thread inventory) memoize here so one lint
+        # run computes each ONCE (analysis/callgraph.graph et al.).
+        self.cache: dict = {}
 
     def exists(self, rel: str) -> bool:
         return (self.root / rel).is_file()
@@ -108,6 +112,8 @@ class Repo:
             if p.is_file():
                 out.append(sub)
                 continue
+            if not p.is_dir():
+                continue  # fixture repos carry only the dirs they seed
             for f in sorted(p.rglob("*.py")):
                 if "__pycache__" in f.parts:
                     continue

@@ -51,6 +51,22 @@ WAIVERS: tuple[Waiver, ...] = (
             "(one fsync per ~64 MB sealed), not on the message path."
         ),
     ),
+    # -- ownership --------------------------------------------------------
+    Waiver(
+        rule="ownership",
+        key="ripplemq_tpu/broker/dataplane.py::DataPlane::_host_ring",
+        reason=(
+            "Deliberate single-writer design: _mirror_records is the "
+            "settle thread's private fast path (one memcpy per settled "
+            "round — putting it under the contended control lock would "
+            "serialize the mirror against every submit), and install() "
+            "only runs on a freshly constructed plane BEFORE start() "
+            "(server._boot_dataplane: install precedes dp.start(), so "
+            "no settle thread exists yet). The two writers are "
+            "separated by the thread-start happens-before edge, not a "
+            "mutex — which the AST cannot see."
+        ),
+    ),
     Waiver(
         rule="lock_discipline",
         key="ripplemq_tpu/storage/segment.py::close::fsync",

@@ -47,6 +47,8 @@ FAST_MODULES = {
     "test_idempotence",         # ~25 s: dedup units + failover replay
     "test_linearizable_reads",  # ~25 s: staged stale-controller clusters
     "test_lint",                # ripplelint fixtures + whole-repo clean run
+    "test_lockwitness",         # witness units: private locks, no cluster
+    "test_concurrency_triage",  # directed repros for the PR 11 race fixes
     "test_log_matching",
     "test_marker_audit",
     "test_metadata",

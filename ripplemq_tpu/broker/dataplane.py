@@ -34,6 +34,7 @@ import struct
 import time
 
 from ripplemq_tpu.core.config import ALIGN, ROW_HEADER as _HDR, EngineConfig
+from ripplemq_tpu.obs.lockwitness import make_condition, make_lock
 from ripplemq_tpu.core.encode import (
     decode_entries_with_pos,
     pack_payload_rows,
@@ -381,8 +382,14 @@ class DataPlane:
         # controller duty's needs_elections gate so exactly that state
         # self-heals by re-election instead of wedging the partition.
         self._nocommit_streak: dict[int, int] = {}
-        self._lock = threading.Lock()          # queues + control tables
-        self._device_lock = threading.Lock()   # every touch of self._state
+        # Locks ride the witness factories (obs/lockwitness.py): raw
+        # threading primitives unless the runtime lock witness is
+        # enabled, in which case acquisition orderings are recorded
+        # under these names and cross-checked against the static graph
+        # (analysis/lock_graph.py) by the chaos smokes.
+        self._lock = make_lock("DataPlane._lock")  # queues + ctrl tables
+        self._device_lock = make_lock(
+            "DataPlane._device_lock")          # every touch of self._state
         self._work = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -413,7 +420,12 @@ class DataPlane:
         # of one slot (device-ordered). 1 disables chaining.
         self.chain_depth = max(1, chain_depth)
         self._zero_round = None  # lazy pad template (chain dispatches)
-        self._dummy = None       # lazy entries placeholder (see _drain)
+        # Entries placeholder ([P, 1, 1], see _dummy_entries): built
+        # EAGERLY — the lazy build was reachable from the step, warm,
+        # and duty threads with no common lock (the ownership lint's
+        # first whole-tree run flagged it; benign-idempotent, but a
+        # pre-spawn constant costs P bytes and zero reasoning).
+        self._dummy = np.zeros((cfg.partitions, 1, 1), np.uint8)
         # Read coalescer: device reads queue here and drain as ONE
         # read_many dispatch of up to read_q queries — the consume-side
         # mirror of append batching. No artificial wait: while one batch
@@ -430,7 +442,7 @@ class DataPlane:
         # (ClusterConfig.read_coalesce_s); 0 disables.
         self.read_coalesce_s = max(0.0, read_coalesce_s)
         self._reads: list[tuple[int, int, int, Future]] = []
-        self._read_lock = threading.Lock()
+        self._read_lock = make_lock("DataPlane._read_lock")
         self._read_work = threading.Event()
         self._read_thread = threading.Thread(
             target=self._read_loop, daemon=True, name="dataplane-read"
@@ -496,7 +508,7 @@ class DataPlane:
         # its log end). Seqs are assigned by the step thread.
         self._dispatch_seq = 0
         self._next_turn = 0
-        self._turnstile = threading.Condition()
+        self._turnstile = make_condition("DataPlane._turnstile")
         # Occupancy counters (bench/admin surface): depth is sampled at
         # each settle enqueue; backpressure counts enqueues that found
         # the window full.
@@ -1475,17 +1487,31 @@ class DataPlane:
                      REC_APPEND)
             return idx
 
-        if self._scan_index is None:
-            self._scan_index = build()
-        entry = self._scan_index.find(slot, offset)
+        # LOCAL-REF discipline (ownership lint, PR 11): concurrent
+        # lagging readers run this on RPC worker threads while store GC
+        # (drop_index_segments, duty thread) and install() null the
+        # cache under the plane's lock. Re-reading `self._scan_index`
+        # between the rebuild and the find raced that invalidation —
+        # a None landing in between raised AttributeError out of a
+        # consume (tests/test_concurrency_triage.py::
+        # test_scan_index_local_ref_race is the directed repro). Every lookup now runs against a local
+        # reference; the shared slot is only SWAPPED, under the lock.
+        idx = self._scan_index
+        if idx is None:
+            idx = build()
+            with self._lock:
+                self._scan_index = idx
+        entry = idx.find(slot, offset)
         if entry is None or not entry[0] <= offset < entry[0] + entry[1]:
             # The cached scan predates records that have since fallen out
             # of the bounded live index (its floor rose past them) — a
             # non-covering answer here could silently jump a consumer
             # over store-resident data. Rebuild once from the current
             # store before trusting it.
-            self._scan_index = build()
-            entry = self._scan_index.find(slot, offset)
+            idx = build()
+            with self._lock:
+                self._scan_index = idx
+            entry = idx.find(slot, offset)
         return entry
 
     def slot_detail(self, slots) -> dict[str, dict[str, int]]:
@@ -1679,9 +1705,9 @@ class DataPlane:
         """The StepInput entries placeholder: the control phase never
         reads entries, and the real rows travel compacted (active-set;
         see _drain). Shaped [P, 1, 1] so the spmd binding can shard its
-        leading axis like the dense field it replaces."""
-        if self._dummy is None:
-            self._dummy = np.zeros((self.cfg.partitions, 1, 1), np.uint8)
+        leading axis like the dense field it replaces. Built eagerly in
+        __init__ (multiple threads reach this; a lazy build here was an
+        unguarded shared write — ownership lint, PR 11)."""
         return self._dummy
 
     def _active_bucket(self, n: int) -> int:

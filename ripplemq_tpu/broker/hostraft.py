@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_rlock
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
@@ -506,7 +508,7 @@ class RaftRunner:
         self.addr_of = addr_of
         self.tick_interval_s = tick_interval_s
         self.rpc_timeout_s = rpc_timeout_s
-        self.lock = threading.RLock()
+        self.lock = make_rlock("RaftRunner.lock")
         self._stop = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=max(4, 2 * len(node.peers)), thread_name_prefix="raft-io"

@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_rlock
 import time
 from typing import Optional
 
@@ -130,7 +132,7 @@ class PartitionManager:
         self.config = config
         self.dataplane = dataplane
         self.slot_map = build_slot_map(config)
-        self.lock = threading.RLock()
+        self.lock = make_rlock("PartitionManager.lock")
 
         # Replicated state (the metadata Raft's state machine).
         self.topics: list[Topic] = []

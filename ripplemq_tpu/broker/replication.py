@@ -51,6 +51,7 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 from ripplemq_tpu.broker.dataplane import NotCommittedError
+from ripplemq_tpu.obs.lockwitness import make_lock
 from ripplemq_tpu.utils.logs import get_logger
 from ripplemq_tpu.wire.transport import RpcError, Transport
 
@@ -102,7 +103,9 @@ class _Sender(threading.Thread):
         super().__init__(daemon=True, name=f"repl-sender-{broker_id}")
         self.broker_id = broker_id
         self._rep = rep
-        self._lock = threading.Lock()
+        # Witness-named mutex; the Condition ALIASES it (one lock, two
+        # handles) — the static graph models the alias the same way.
+        self._lock = make_lock("_Sender._lock")
         self._cond = threading.Condition(self._lock)
         self._queue: list[tuple[list, Future]] = []
         self._buffer: Optional[list[tuple[list, Future]]] = None
@@ -347,7 +350,7 @@ class RoundReplicator:
             self._c_records = self._c_frames = self._c_retries = None
             self._c_bytes = None
             self._clock = time.perf_counter
-        self._lock = threading.Lock()
+        self._lock = make_lock("RoundReplicator._lock")
         self._senders: dict[int, _Sender] = {}
         self._joining: set[int] = set()
         self._suspects: set[int] = set()

@@ -44,6 +44,7 @@ from typing import Callable, Optional
 
 
 from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
+from ripplemq_tpu.obs.lockwitness import make_lock
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner
 from ripplemq_tpu.broker.manager import (
     OP_BATCH,
@@ -98,7 +99,7 @@ class _BarrierGate:
 
     def __init__(self, fire) -> None:
         self._fire = fire
-        self._lock = threading.Lock()
+        self._lock = make_lock("_BarrierGate._lock")
         self._pending = None  # Future whose fire has NOT started yet
 
     def wait(self, timeout_s: float) -> None:
@@ -162,6 +163,16 @@ class BrokerServer:
         self.broker_id = broker_id
         self.config = config
         self.info = config.broker(broker_id)
+        if config.lock_witness:
+            # Debug lock witness (obs/lockwitness.py): enabled BEFORE
+            # any lock below is constructed, so every host-path mutex
+            # this broker creates records acquisition orderings.
+            # Process-global by design — an in-proc cluster's brokers
+            # share one witnessed graph, which is what the chaos
+            # cross-check wants.
+            from ripplemq_tpu.obs import lockwitness
+
+            lockwitness.enable()
         # --- telemetry plane (obs/): one metrics registry + one flight-
         # recorder ring per broker, created FIRST so every layer below
         # (store, replicator, data plane) threads through the same pair.
@@ -318,7 +329,7 @@ class BrokerServer:
         )
         self._broker_pid_proposed = 0.0
         self._broker_pid_refreshed = 0.0
-        self._stamp_lock = threading.Lock()
+        self._stamp_lock = make_lock("BrokerServer._stamp_lock")
         self._stamp_seqs: dict[int, int] = {}
         persist_fn = None
         if data_dir is not None:
@@ -390,7 +401,13 @@ class BrokerServer:
         self._duty_thread = threading.Thread(
             target=self._duty_loop, daemon=True, name=f"broker-duty-{broker_id}"
         )
-        self.duty_errors: list[str] = []  # ring of recent duty failures
+        # Ring of recent duty failures. Mutated (append + del-slice
+        # trim) from the duty loop AND catch-up threads — the pair of
+        # list ops must not interleave across threads (ownership lint,
+        # PR 11), so every mutation rides _errors_lock; snapshot reads
+        # (admin.stats list()) stay bare.
+        self._errors_lock = make_lock("BrokerServer._errors_lock")
+        self.duty_errors: list[str] = []
         # Membership-poll cadence (reference: the 10 s membership monitor,
         # TopicsRaftServer.java:216): assignment/controller planning runs
         # at most every membership_poll_s, first pass immediate.
@@ -1806,13 +1823,19 @@ class BrokerServer:
         plus `n` sequence numbers from the per-slot counter. (0, -1)
         while the pid is still registering: the produce flows unstamped
         rather than stall behind the metadata raft."""
-        pid = self._broker_pid
-        if pid is None:
-            pid = self.manager.producer_id(self._broker_pid_name)
-            if pid is None:
-                return 0, -1
-            self._broker_pid = pid
+        # The pid adopt and the sequence stamp share ONE critical
+        # section (_stamp_lock): the duty's reap-adoption also writes
+        # _broker_pid, and an unguarded lazy write here could stamp a
+        # sequence against a pid the duty was swapping out from under
+        # it (ownership lint, PR 11 — the stamp and its pid must be one
+        # consistent pair).
         with self._stamp_lock:
+            pid = self._broker_pid
+            if pid is None:
+                pid = self.manager.producer_id(self._broker_pid_name)
+                if pid is None:
+                    return 0, -1
+                self._broker_pid = pid
             seq = self._stamp_seqs.get(slot, 0)
             self._stamp_seqs[slot] = seq + n
         return pid, seq
@@ -1839,8 +1862,11 @@ class BrokerServer:
             # the reconciler deletes each tick (a silent duplicate
             # window on the forwarded hop). Sequence counters carry
             # over safely: the fresh pid's table is empty, so every
-            # current counter value is above its settled end.
-            self._broker_pid = cur
+            # current counter value is above its settled end. Adopted
+            # under _stamp_lock — the stamping path reads pid + seq as
+            # one pair under the same lock (ownership lint, PR 11).
+            with self._stamp_lock:
+                self._broker_pid = cur
         if cur is not None:
             retention = self.config.pid_retention_s
             if retention <= 0:
@@ -2284,8 +2310,9 @@ class BrokerServer:
             except Exception as e:  # duties must never kill the loop
                 log.warning("broker %d duty error: %s: %s",
                             self.broker_id, type(e).__name__, e)
-                self.duty_errors.append(f"{type(e).__name__}: {e}")
-                del self.duty_errors[:-20]
+                with self._errors_lock:
+                    self.duty_errors.append(f"{type(e).__name__}: {e}")
+                    del self.duty_errors[:-20]
 
     def _metadata_leader_duty(self) -> None:
         node = self.runner.node
@@ -2692,16 +2719,19 @@ class BrokerServer:
             else:
                 log.warning("broker %d: catchup(%d) membership proposal "
                             "failed; will retry", self.broker_id, cand)
-                self.duty_errors.append(f"catchup({cand}): membership "
-                                        "proposal failed; will retry")
-                del self.duty_errors[:-20]
+                with self._errors_lock:
+                    self.duty_errors.append(
+                        f"catchup({cand}): membership proposal failed; "
+                        f"will retry")
+                    del self.duty_errors[:-20]
         except Exception as e:
             log.warning("broker %d: catchup(%d) failed: %s: %s",
                         self.broker_id, cand, type(e).__name__, e)
-            self.duty_errors.append(
-                f"catchup({cand}): {type(e).__name__}: {e}"
-            )
-            del self.duty_errors[:-20]
+            with self._errors_lock:
+                self.duty_errors.append(
+                    f"catchup({cand}): {type(e).__name__}: {e}"
+                )
+                del self.duty_errors[:-20]
         finally:
             # Success AND failure both leave the joining state: a joined
             # member now acks via the set; a failed join is fully unwound

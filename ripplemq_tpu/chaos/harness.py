@@ -242,6 +242,7 @@ def run_chaos(
     include_timeline: bool = False,
     groups: int = 0,
     replication_mode: str = "full",
+    lock_witness: bool = False,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -274,10 +275,26 @@ def run_chaos(
     nemesis's wall-clocked fault ops merged with every broker's flight-
     recorder events, sorted by time: fault vs lifecycle in one view).
     `include_postmortems`/`include_timeline` force them onto clean
-    verdicts too (profiles/chaos_soak.py --postmortems/--timeline)."""
+    verdicts too (profiles/chaos_soak.py --postmortems/--timeline).
+
+    `lock_witness=True` (in-proc backend) enables the runtime lock
+    witness (obs/lockwitness.py) for the whole run: every host-path
+    lock the cluster constructs records actual per-thread acquisition
+    orderings, and the verdict gains a `lock_witness` section. Two
+    cross-checks become VIOLATIONS: a witnessed cycle (a deadlock that
+    has not scheduled yet), and a witnessed edge outside the static
+    lock graph's transitive closure (`analysis/lock_graph.py` — an
+    ordering the AST missed via indirection must become a derived or
+    declared static edge, or the gap grows silently)."""
     t0 = time.time()
     topic = "chaos"
     tmp = None
+    witness_on = bool(lock_witness) and backend != "proc"
+    if witness_on:
+        from ripplemq_tpu.obs import lockwitness
+
+        lockwitness.reset()
+        lockwitness.enable()
     if data_dir is None:
         # Durable stores are load-bearing: an in-proc restart recovers
         # the committed-round stream from disk, which is what makes the
@@ -412,6 +429,41 @@ def run_chaos(
                     f"group convergence failed within "
                     f"{converge_timeout_s}s: {group_verdict}"
                 )
+        if lock_witness and not witness_on:
+            # Asked for but unavailable: the witness cross-check is
+            # in-proc only (the orderings live in broker SUBPROCESS
+            # memory on the proc backend, with nothing to report
+            # them). Say so in the verdict — a run that looks
+            # witnessed but was not must never read as verified.
+            verdict["lock_witness"] = {
+                "enabled": False,
+                "skipped": "proc backend: witness cross-check is "
+                           "in-proc only",
+            }
+        if witness_on:
+            # The witnessed graph must be acyclic AND contained in the
+            # static graph's closure — either failure is a first-class
+            # violation, exactly like acked loss: a cycle is a deadlock
+            # that has not scheduled yet, and an uncovered edge is
+            # static-analysis coverage silently lost to indirection.
+            # (default_closure memoizes the repo parse across seeds.)
+            from ripplemq_tpu.analysis.lock_graph import default_closure
+            from ripplemq_tpu.obs import lockwitness
+
+            wreport = lockwitness.report(static_closure=default_closure())
+            verdict["lock_witness"] = wreport
+            if not wreport["acyclic"]:
+                violations.append(
+                    f"lock witness observed acquisition cycles: "
+                    f"{wreport['cycles']}"
+                )
+            if wreport["uncovered_edges"]:
+                violations.append(
+                    f"lock witness observed orderings outside the "
+                    f"static lock graph's closure: "
+                    f"{wreport['uncovered_edges']} — derive or declare "
+                    f"them (analysis/lock_graph.py DECLARED_EDGES)"
+                )
         ops = history.ops()
         # Telemetry collection — while the cluster is still up. Every
         # VIOLATING verdict carries the full diagnosis (per-broker
@@ -478,6 +530,10 @@ def run_chaos(
         return verdict
     finally:
         cluster.stop()
+        if witness_on:
+            from ripplemq_tpu.obs import lockwitness
+
+            lockwitness.disable()
         if tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
 

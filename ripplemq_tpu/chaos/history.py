@@ -37,6 +37,8 @@ next to its fault trace and replayed offline.
 from __future__ import annotations
 
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 import time
 from typing import Optional
 
@@ -48,7 +50,7 @@ class History:
     concurrently with nemesis phases)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("History._lock")
         self._ops: list[dict] = []
 
     def record(self, **op) -> None:

@@ -140,6 +140,7 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "rpc_workers": config.rpc_workers,
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
+        "lock_witness": config.lock_witness,
     }
 
 

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 from typing import Optional
 
 from ripplemq_tpu.metadata.models import BrokerInfo, Topic, topics_from_wire
@@ -53,7 +55,7 @@ class MetadataManager:
             deadline_s=deadline_s,
             rng=self._rng,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetadataManager._lock")
         self._topics: dict[str, Topic] = {}
         self._brokers: dict[int, BrokerInfo] = {}
         self._stop = threading.Event()

@@ -20,6 +20,8 @@ would dedupe them away).
 from __future__ import annotations
 
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 import time
 import uuid
 from typing import Optional
@@ -67,7 +69,7 @@ class ProducerClient:
         # the server's pid_retention_s (default 600 s); 0 disables.
         self._pid_refresh_s = float(pid_refresh_s)
         self._pid_registered_t = 0.0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("ProducerClient._seq_lock")
         self._seqs: dict[tuple[str, int], int] = {}
         self._selector = selector or RoundRobinSelector()
         self._timeout = rpc_timeout_s

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 import zlib
 
 from ripplemq_tpu.metadata.models import Topic
@@ -25,7 +27,7 @@ class RoundRobinSelector(PartitionSelector):
 
     def __init__(self) -> None:
         self._counters: dict[str, itertools.count] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("RoundRobinSelector._lock")
 
     def select(self, topic: Topic, key: bytes | None = None) -> int:
         with self._lock:

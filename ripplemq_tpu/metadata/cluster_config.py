@@ -147,6 +147,14 @@ class ClusterConfig:
     # (admin.trace) stays on either way: its per-round cost is a few
     # hundred ns and its value is being on when nobody planned to need it.
     obs: bool = True
+    # Runtime lock witness (obs/lockwitness.py): when true, every
+    # host-path lock this process creates is a recording wrapper that
+    # captures per-thread acquisition orderings, cross-checkable
+    # against the static lock-order graph (analysis/lock_graph.py).
+    # OFF by default — the factories hand out raw threading locks with
+    # zero overhead; debug/chaos harnesses turn it on (run_chaos
+    # lock_witness=True, profiles/chaos_soak.py --witness).
+    lock_witness: bool = False
     # RPC worker pool per broker. A produce/engine.append handler BLOCKS
     # its worker until the round commits, so this caps a broker's
     # in-flight appends — size it to the offered concurrency (threads
@@ -286,6 +294,8 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
     if "obs" in raw:
         extra["obs"] = bool(raw["obs"])
+    if "lock_witness" in raw:
+        extra["lock_witness"] = bool(raw["lock_witness"])
     if "durability" in raw:
         extra["durability"] = str(raw["durability"])
     if "replication" in raw:

@@ -14,6 +14,13 @@ arXiv:2402.15627):
 - `obs.postmortem` — the one-shot diagnosis bundle (control-table vs
   device terms, log ends, stall streaks, settled gaps, the recent trace
   ring) served as `admin.postmortem` by every broker.
+- `obs.lockwitness` — the runtime lock witness (PR 11): named lock
+  factories that are raw `threading` primitives by default and, when
+  enabled (`ClusterConfig.lock_witness`, chaos `--witness`), record
+  per-thread acquisition orderings for the cross-check against the
+  static lock-order graph (`analysis/lock_graph.py`). Not imported
+  here: the factories must stay import-light so every lock-owning
+  module can use them without cycles.
 """
 
 from ripplemq_tpu.obs.metrics import Metrics

@@ -165,7 +165,9 @@ class Metrics:
             self.clock = time.perf_counter
         else:
             self.clock = lambda: 0.0
-        self._lock = threading.Lock()
+        from ripplemq_tpu.obs.lockwitness import make_lock
+
+        self._lock = make_lock("Metrics._lock")
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}

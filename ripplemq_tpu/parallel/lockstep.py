@@ -35,6 +35,7 @@ from typing import Any
 
 import numpy as np
 
+from ripplemq_tpu.obs.lockwitness import make_lock
 from ripplemq_tpu.utils.logs import get_logger
 
 log = get_logger("lockstep")
@@ -104,7 +105,7 @@ class LockstepController:
             )
         self._timeout = rpc_timeout_s
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("LockstepController._lock")
         self.mesh = inner.mesh
         # Set (to a reason string) the first time a broadcast or replay
         # fails: the mesh is permanently out of lockstep — no later call
@@ -188,8 +189,13 @@ class LockstepController:
         except Exception as e:
             # Broadcast (or local launch) failed after the stream became
             # non-replayable (some worker holds a seq the others never
-            # saw, or the local copy diverged): permanently broken.
-            self.broken = f"{type(e).__name__}: {e}"
+            # saw, or the local copy diverged): permanently broken. The
+            # latch flips under the sequence lock (ownership lint,
+            # PR 11): every engine entry point can reach this line, and
+            # an unguarded write left the break diagnostic ordered by
+            # nothing (error path — the extra acquire costs nothing).
+            with self._lock:
+                self.broken = f"{type(e).__name__}: {e}"
             raise
         try:
             self._check(futs)
@@ -199,7 +205,8 @@ class LockstepController:
             # caller (DataPlane) can adopt the new state and fail loudly
             # with the lockstep-break diagnostic, instead of wedging every
             # subsequent engine call on donated-buffer errors.
-            self.broken = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self.broken = f"{type(e).__name__}: {e}"
             e.lockstep_result = result
             raise
         return result
@@ -302,7 +309,7 @@ class LockstepWorker:
     (plug into a TcpServer dispatch)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("LockstepWorker._lock")
         self._expected_seq = 1
         self._fns = None
         self._state = None

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import bisect
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 from typing import Any, Iterable, Optional
 
 
@@ -42,7 +44,7 @@ class LogIndex:
         self._bases: dict[int, list[int]] = {}
         self._entries: dict[int, list[tuple[int, int, Any]]] = {}
         self._max = max(2, max_entries_per_slot)
-        self._lock = threading.Lock()
+        self._lock = make_lock("LogIndex._lock")
 
     def add(self, slot: int, base: int, nrows: int, locator: Any) -> None:
         """Record one committed append round. Drops previously-indexed

@@ -15,6 +15,8 @@ surviving broker loss through replication, not disk).
 from __future__ import annotations
 
 import threading
+
+from ripplemq_tpu.obs.lockwitness import make_lock
 from typing import Iterator
 
 
@@ -23,7 +25,7 @@ class MemoryRoundStore:
 
     def __init__(self) -> None:
         self._records: list[tuple[int, int, int, bytes]] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemoryRoundStore._lock")
 
     def append(self, rec_type: int, slot: int, base: int,
                payload: bytes) -> bytes:

@@ -21,6 +21,7 @@ import threading
 import zlib
 from typing import Iterator, Optional
 
+from ripplemq_tpu.obs.lockwitness import make_lock
 from ripplemq_tpu.utils.logs import get_logger
 
 _log = get_logger("storage")
@@ -249,7 +250,7 @@ class SegmentStore:
         if use_native is True and lib is None:
             raise RuntimeError("native segstore requested but unavailable")
         self._lib = lib
-        self._lock = threading.Lock()
+        self._lock = make_lock("SegmentStore._lock")
         if lib is not None:
             self._handle = lib.segstore_open(
                 directory.encode(), ctypes.c_long(segment_bytes)
@@ -487,21 +488,28 @@ class SegmentStore:
     def _kick_erasure(self) -> None:
         """Start (or skip, if one is running) the background shard
         encoder; rate-limited so rotation-free flushes don't pay even a
-        listdir."""
+        listdir. Check-and-start runs under the store lock: the kick is
+        reachable from the settle path's flush, barrier flushes, and
+        the flusher thread, and the unguarded alive-check let two
+        concurrent kicks both start a worker (ownership lint, PR 11;
+        harmless output, doubled encode I/O). Callers never hold _lock
+        here — flush() releases it before kicking."""
         import time
 
         now = time.monotonic()
-        if now - self._erasure_check_t < 1.0:
-            return
-        self._erasure_check_t = now
-        t = self._erasure_thread
-        if t is not None and t.is_alive():
-            return
-        t = threading.Thread(
-            target=self._erasure_worker, daemon=True, name="segstore-erasure"
-        )
-        self._erasure_thread = t
-        t.start()
+        with self._lock:
+            if now - self._erasure_check_t < 1.0:
+                return
+            self._erasure_check_t = now
+            t = self._erasure_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(
+                target=self._erasure_worker, daemon=True,
+                name="segstore-erasure",
+            )
+            self._erasure_thread = t
+            t.start()
 
     def _erasure_worker(self) -> None:
         from ripplemq_tpu.storage.erasure import protect_store
@@ -511,8 +519,11 @@ class SegmentStore:
         except Exception as e:  # derived data: never take the store down
             _log.warning("erasure encode failed for %s: %s: %s",
                          self.directory, type(e).__name__, e)
-            self.erasure_errors.append(f"{type(e).__name__}: {e}")
-            del self.erasure_errors[:-20]
+            # append + del-slice trim must not interleave with another
+            # writer (ownership lint, PR 11): error path, lock is free.
+            with self._lock:
+                self.erasure_errors.append(f"{type(e).__name__}: {e}")
+                del self.erasure_errors[:-20]
 
     def gc(self) -> list[int]:
         """Delete the oldest sealed segments while their total size

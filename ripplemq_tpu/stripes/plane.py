@@ -64,6 +64,7 @@ from ripplemq_tpu.stripes.codec import (
     encode_group,
     stripe_assignment,
 )
+from ripplemq_tpu.obs.lockwitness import make_condition, make_lock
 from ripplemq_tpu.utils.logs import get_logger
 
 log = get_logger("stripes")
@@ -130,7 +131,7 @@ class _StripeSender(threading.Thread):
         super().__init__(daemon=True, name=f"stripe-sender-{broker_id}")
         self.broker_id = broker_id
         self._rep = rep
-        self._cond = threading.Condition()
+        self._cond = make_condition("_StripeSender._cond")
         self._queue: list[tuple] = []
         self._buffer: Optional[list[tuple]] = None
         self._stopped = False
@@ -337,7 +338,7 @@ class StripeReplicator:
             self._c_bytes = self._c_frames = None
             self._c_groups = self._c_retries = None
             self._clock = time.perf_counter
-        self._lock = threading.Lock()
+        self._lock = make_lock("StripeReplicator._lock")
         self._senders: dict[int, _StripeSender] = {}
         self._joining: set[int] = set()
         self._suspects: set[int] = set()
@@ -368,7 +369,7 @@ class StripeReplicator:
         self._floor_pending: list[int] = []  # heapq of outstanding gsns
         self._floor_done: set[int] = set()
         # Encoder queue: (records, fut) pairs drained as group commits.
-        self._enc_cond = threading.Condition()
+        self._enc_cond = make_condition("StripeReplicator._enc_cond")
         self._pending: list[tuple[list, Future]] = []
         self._encoder = threading.Thread(
             target=self._encode_loop, daemon=True, name="stripe-encoder"

@@ -31,6 +31,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
+from ripplemq_tpu.obs.lockwitness import make_lock
+
 from ripplemq_tpu.wire import codec
 
 Handler = Callable[[dict], dict]
@@ -92,7 +94,7 @@ class InProcNetwork:
         self._drops: dict[tuple[str, str], int] = {}
         self._dups: dict[tuple[str, str], int] = {}
         self._delays: dict[tuple[str, str], tuple[int, float]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("InProcNetwork._lock")
         self.calls: list[tuple[str, str, str]] = []  # (src, dst, type) trace
         # Duplications actually DELIVERED (handler ran twice) — distinct
         # from charges consumed by requests that also hit a block/drop.
@@ -257,7 +259,7 @@ class TcpServer:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._conns: set[socket.socket] = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("TcpServer._lock")
 
     def start(self) -> None:
         t = threading.Thread(target=self._accept_loop, daemon=True, name="tcp-accept")
@@ -341,9 +343,9 @@ class _Conn:
         self.sock = socket.create_connection((host, int(port_s)), timeout=connect_timeout)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.write_lock = threading.Lock()
+        self.write_lock = make_lock("_Conn.write_lock")
         self.pending: dict[int, Future] = {}
-        self.pending_lock = threading.Lock()
+        self.pending_lock = make_lock("_Conn.pending_lock")
         self.dead = False
         self.reader = threading.Thread(target=self._read_loop, daemon=True,
                                        name=f"tcp-client-{addr}")
@@ -364,8 +366,13 @@ class _Conn:
             self._fail_all(RpcError(f"connection lost: {e}"))
 
     def _fail_all(self, exc: Exception) -> None:
-        self.dead = True
+        # The dead latch flips INSIDE pending_lock (ownership lint,
+        # PR 11): send() checks it under the same lock, so every future
+        # either sees dead (refused) or sits in the dict this swap
+        # takes — a latch flipped outside the critical section leaves
+        # that pairing to the GIL's mercy.
         with self.pending_lock:
+            self.dead = True
             pending, self.pending = self.pending, {}
         for fut in pending.values():
             if not fut.done():
@@ -397,7 +404,7 @@ class TcpClient(Transport):
 
     def __init__(self, connect_timeout: float = 3.0) -> None:
         self._conns: dict[str, _Conn] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("TcpClient._lock")
         self._ids = itertools.count(1)
         self._connect_timeout = connect_timeout
 

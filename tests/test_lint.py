@@ -30,11 +30,14 @@ from ripplemq_tpu.analysis import (
     config_plumbing,
     determinism,
     lock_discipline,
+    lock_graph,
     markers,
+    ownership,
     retry_taxonomy,
     run_lint,
     shard_shapes,
     stats_schema,
+    threads,
     trace_vocab,
 )
 from ripplemq_tpu.analysis.framework import validate_ledger
@@ -409,6 +412,346 @@ def test_marker_slow_detection():
     assert not markers.is_slow_marked(_parse("x = 1\n"))
 
 
+# ---- threads: the un-inventoried-thread class ------------------------
+
+
+def _seed_tree(tmp_path, files: dict[str, str]) -> Repo:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return Repo(tmp_path)
+
+
+def test_threads_fixture_caught(tmp_path):
+    """The seeded regression: a spawn whose target the inventory cannot
+    resolve (a thread nobody can map to code), and a derivable thread
+    missing from the README Concurrency-model table."""
+    repo = _seed_tree(tmp_path, {
+        "ripplemq_tpu/mod.py": """
+            import threading
+
+            class Plane:
+                def start(self):
+                    t = threading.Thread(target=self._loop, name="plane")
+                    t.start()
+                    # Unresolvable: a handler-dict target is a thread
+                    # the inventory cannot attribute to any code.
+                    threading.Thread(target=self.handlers["x"]).start()
+
+                def _loop(self):
+                    pass
+        """,
+        "README.md": "## Concurrency model\n\nno rows here\n",
+    })
+    keys = {f.key for f in threads.check(repo)}
+    assert "ripplemq_tpu/mod.py::Plane.start::unresolved_spawn" in keys
+    assert "readme::ripplemq_tpu/mod.py::Plane._loop" in keys
+    # Documenting the derived entry clears the drift half; a bogus row
+    # is flagged from the other direction.
+    (tmp_path / "README.md").write_text(
+        "## Concurrency model\n\n"
+        "| `plane` | `ripplemq_tpu/mod.py::Plane._loop` |\n"
+        "| `ghost` | `ripplemq_tpu/mod.py::Plane._gone` |\n")
+    keys = {f.key for f in threads.check(Repo(tmp_path))}
+    assert "readme::ripplemq_tpu/mod.py::Plane._loop" not in keys
+    assert "dead::ripplemq_tpu/mod.py::Plane._gone" in keys
+
+
+def test_threads_inventory_matches_live_tree():
+    repo = Repo()
+    entries, findings = threads.inventory(repo)
+    assert findings == [], [f.message for f in findings]
+    keys = {e.key for e in entries}
+    # The load-bearing entries the README table documents.
+    assert {"ripplemq_tpu/broker/dataplane.py::DataPlane._run",
+            "ripplemq_tpu/broker/dataplane.py::DataPlane._settle_loop",
+            "ripplemq_tpu/broker/replication.py::_Sender.run",
+            "ripplemq_tpu/stripes/plane.py::StripeReplicator._encode_loop",
+            "ripplemq_tpu/storage/segment.py::SegmentStore._flush_loop",
+            "ripplemq_tpu/broker/hostraft.py::RaftRunner._run"} <= keys
+    # The closure is non-trivial: the duty loop reaches deep.
+    reach = threads.reachable_map(repo)
+    duty = reach["ripplemq_tpu/broker/server.py::BrokerServer._duty_loop"]
+    assert len(duty) > 50
+
+
+# ---- lock_graph: the two-lock inversion class ------------------------
+
+CYCLE_SRC = {
+    "ripplemq_tpu/mod.py": """
+        import threading
+
+        class P:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """,
+}
+
+
+def test_lock_graph_cycle_fixture_caught(tmp_path):
+    repo = _seed_tree(tmp_path, CYCLE_SRC)
+    keys = {f.key for f in lock_graph.check(repo)}
+    assert "cycle::P._a_lock<->P._b_lock" in keys
+    # Consistent ordering (the fix): no cycle, no finding.
+    repo2 = _seed_tree(tmp_path / "fixed", {
+        "ripplemq_tpu/mod.py": CYCLE_SRC["ripplemq_tpu/mod.py"].replace(
+            "with self._b_lock:\n                    with self._a_lock:",
+            "with self._a_lock:\n                    with self._b_lock:"),
+    })
+    assert {f.key for f in lock_graph.check(repo2)} == set()
+
+
+def test_lock_graph_interprocedural_and_self_deadlock(tmp_path):
+    """A self-re-acquisition through a helper call (plain Lock) is the
+    classic hidden deadlock; the same shape through an RLock is legal."""
+    repo = _seed_tree(tmp_path, {
+        "ripplemq_tpu/mod.py": """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+
+            class R:
+                def __init__(self):
+                    self.lock = threading.RLock()
+
+                def outer(self):
+                    with self.lock:
+                        self.helper()
+
+                def helper(self):
+                    with self.lock:
+                        pass
+        """,
+    })
+    keys = {f.key for f in lock_graph.check(repo)}
+    assert "cycle::P._lock" in keys
+    assert not any("R.lock" in k for k in keys)
+
+
+def test_lock_graph_condition_alias_and_witness_name(tmp_path):
+    repo = _seed_tree(tmp_path, {
+        "ripplemq_tpu/mod.py": """
+            import threading
+            from ripplemq_tpu.obs.lockwitness import make_lock
+
+            class P:
+                def __init__(self):
+                    self._lock = make_lock("Wrong.name")
+                    self._cond = threading.Condition(self._lock)
+        """,
+    })
+    findings = lock_graph.check(repo)
+    assert any(f.key == "witness_name::P._lock" for f in findings)
+    lg = lock_graph.build_graph(repo)
+    # Condition(self._lock) ALIASES: one node, not two.
+    assert ("P", "_cond") in lg.aliases
+    assert "P._cond" not in lg.locks and "P._lock" in lg.locks
+
+
+def test_lock_graph_live_tree_edges_and_closure():
+    """The derived graph knows the real cross-object orderings, and the
+    closure (derived ∪ declared) covers what the runtime witness
+    observes in the chaos smokes."""
+    repo = Repo()
+    lg = lock_graph.build_graph(repo)
+    assert ("PartitionManager.lock", "DataPlane._lock") in lg.edges
+    assert ("DataPlane._device_lock",
+            "LockstepController._lock") in lg.edges
+    closure = lg.closure()
+    # The declared RaftRunner→manager edge (apply_fn indirection, found
+    # by the first witnessed chaos run) closes transitively onto the
+    # plane the manager drives.
+    assert ("RaftRunner.lock", "PartitionManager.lock") in closure
+    assert ("RaftRunner.lock", "DataPlane._lock") in closure
+
+
+# ---- ownership: the unowned-shared-write class -----------------------
+
+OWNERSHIP_SRC = """
+    import threading
+
+    class Plane:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._flag = False
+            self._t = threading.Thread(target=self._loop)
+
+        def _loop(self):
+            self._flag = True
+
+        def stop(self):
+            self._flag = False
+"""
+
+
+def test_ownership_fixture_caught(tmp_path):
+    repo = _seed_tree(tmp_path, {"ripplemq_tpu/broker/mod.py":
+                                 OWNERSHIP_SRC})
+    keys = {f.key for f in ownership.check(repo)}
+    assert "ripplemq_tpu/broker/mod.py::Plane::_flag" in keys
+    # Guarding BOTH writes with one mutex clears it.
+    guarded = OWNERSHIP_SRC.replace(
+        "            self._flag = True",
+        "            with self._lock:\n"
+        "                self._flag = True").replace(
+        "            self._flag = False\n",
+        "            with self._lock:\n"
+        "                self._flag = False\n", 1)
+    # Only the post-__init__ writes need guards; replace the stop()
+    # one too (the __init__ write is exempt by construction).
+    guarded = guarded.replace(
+        "        def stop(self):\n            self._flag = False",
+        "        def stop(self):\n            with self._lock:\n"
+        "                self._flag = False")
+    repo2 = _seed_tree(tmp_path / "fixed",
+                       {"ripplemq_tpu/broker/mod.py": guarded})
+    assert {f.key for f in ownership.check(repo2)} == set()
+
+
+def test_ownership_caller_held_propagation(tmp_path):
+    """The RaftNode/RaftRunner convention: the wrapper's lock guards
+    the inner state machine — writes inside the inner class are clean
+    when every runtime call path holds the wrapper's lock, and flagged
+    again the moment one unlocked path exists."""
+    base = """
+        import threading
+
+        class Node:
+            def __init__(self):
+                self.x = 0
+
+            def tick(self):
+                self.x += 1
+
+        class Runner:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.node = Node()
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self.lock:
+                    self.node.tick()
+
+            def handle(self):
+                with self.lock:
+                    self.node.tick()
+    """
+    repo = _seed_tree(tmp_path, {"ripplemq_tpu/broker/mod.py": base})
+    assert {f.key for f in ownership.check(repo)} == set()
+    leaky = base + """
+        class Leak:
+            def __init__(self):
+                self.n = Node()
+
+            def poke(self):
+                self.n.tick()
+    """
+    repo2 = _seed_tree(tmp_path / "leaky",
+                       {"ripplemq_tpu/broker/mod.py": leaky})
+    keys = {f.key for f in ownership.check(repo2)}
+    assert "ripplemq_tpu/broker/mod.py::Node::x" in keys
+
+
+def test_ownership_del_mutation_counts_as_write(tmp_path):
+    """`del self._tab[k]` mutates shared state exactly like a
+    subscript store — delete targets carry ast.Del ctx, and matching
+    Store alone silently dropped the whole mutation class (review
+    finding on this PR's first cut)."""
+    repo = _seed_tree(tmp_path, {"ripplemq_tpu/broker/mod.py": """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._tab = {}
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                del self._tab[0]
+
+            def drop(self, k):
+                del self._tab[k]
+    """})
+    keys = {f.key for f in ownership.check(repo)}
+    assert "ripplemq_tpu/broker/mod.py::Plane::_tab" in keys
+
+
+def test_lock_graph_flags_lock_owning_class_collision(tmp_path):
+    """Two same-named classes that BOTH own locks: the bare-name class
+    map shadows one, silently dropping its locks from the graph — made
+    a finding instead of a blind spot."""
+    repo = _seed_tree(tmp_path, {
+        "ripplemq_tpu/a.py": """
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._lock = threading.Lock()
+        """,
+        "ripplemq_tpu/b.py": """
+            import threading
+
+            class Plane:
+                def __init__(self):
+                    self._other_lock = threading.Lock()
+        """,
+    })
+    keys = {f.key for f in lock_graph.check(repo)}
+    assert "collision::Plane" in keys
+
+
+def test_ownership_init_chain_exempt(tmp_path):
+    """restore()-style boot helpers called only from __init__ run
+    before any spawn: their writes must not read as racy."""
+    repo = _seed_tree(tmp_path, {"ripplemq_tpu/broker/mod.py": """
+        import threading
+
+        class Node:
+            def __init__(self):
+                self.x = 0
+
+            def restore(self, v):
+                self.x = v
+
+            def tick(self):
+                self.x += 1
+
+        class Runner:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.node = Node()
+                self.node.restore(7)
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self.lock:
+                    self.node.tick()
+    """})
+    assert {f.key for f in ownership.check(repo)} == set()
+
+
 # ===================================================== whole-tree gates
 
 
@@ -451,9 +794,11 @@ def test_tree_is_clean():
         f"ripplelint dirty: {json.dumps(dirty, indent=2)[:4000]}\n"
         f"stale: {report['stale_waivers']}"
     )
-    # All the advertised rules ran.
+    # All the advertised rules ran — including the PR 11 concurrency
+    # plane (threads / lock_graph / ownership).
     assert set(report["checkers"]) == set(CHECKERS)
-    assert len(CHECKERS) >= 7
+    assert len(CHECKERS) >= 11
+    assert {"threads", "lock_graph", "ownership"} <= set(CHECKERS)
 
 
 def test_json_verdict_shape_and_budget():

@@ -163,6 +163,7 @@ def test_config_yaml_dict_round_trips_every_field():
         linearizable_reads=True,
         durability="strict",
         obs=False,
+        lock_witness=True,
         rpc_workers=7,
     )
     raw = yaml.safe_load(yaml.safe_dump(_config_yaml_dict(config)))
