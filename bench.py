@@ -44,6 +44,11 @@ Field map:
   packed-write levers (EngineConfig.fused_control / .packed_writes)
   vs the legacy path: control-only ms/round, full and quarter-batch
   sustained rates (also standalone: profiles/control_ab.py).
+- `host_plane_scaling` — the multi-core host plane's same-host worker
+  sweep (ISSUE 12): full e2e topology at `host_workers` 1/2/4 per
+  broker, subprocess clients, identical best-of-N method and
+  count-exact readback per arm; `scaling_x` = best arm / workers-1
+  baseline, `host_cores` the parallelism physically present.
 
 `round_rtt_ms` is the measured single-round dispatch+fetch time on this
 chip/link — the floor any ack latency pays; read the percentiles against
@@ -786,11 +791,15 @@ def _run_spmd_scaling(device_counts: tuple[int, ...] = (1, 2, 4, 8),
     }
 
 
-def e2e_raw_config(ports: list[int], partitions: int = 1024) -> dict:
+def e2e_raw_config(ports: list[int], partitions: int = 1024,
+                   host_workers: int = 1) -> dict:
     """The e2e topology's cluster config (shared with
     profiles/host_edge.py, whose decomposition must measure the SAME
-    shape the bench runs — a copied dict drifts)."""
+    shape the bench runs — a copied dict drifts). `host_workers` > 1
+    boots the multi-core host plane (parallel/hostplane.py) on every
+    broker — the host_plane_scaling phase's sweep axis."""
     return {
+        "host_workers": host_workers,
         "brokers": [{"id": i, "host": "127.0.0.1", "port": p}
                     for i, p in enumerate(ports)],
         "topics": [{"name": "bench", "partitions": partitions,
@@ -864,6 +873,22 @@ _DECOMPOSITION_STAGES = (
 )
 
 
+def _proc_cpu_s(pid: int) -> float:
+    """utime+stime of one live process from /proc/<pid>/stat, seconds
+    (Linux; 0.0 anywhere it can't be read) — the e2e bench's honest
+    per-process CPU decomposition (PROFILE.md round 12): on a GIL-bound
+    host path, WHERE the interpreter seconds land is the measurement
+    that says whether a topology knob moved work off the broker."""
+    try:
+        import os
+
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            fields = f.read().rsplit(b") ", 1)[1].split()
+        return (int(fields[11]) + int(fields[12])) / os.sysconf("SC_CLK_TCK")
+    except Exception:
+        return 0.0
+
+
 def _latency_decomposition(metrics_snapshot: dict) -> dict:
     """The per-stage summaries (count/mean/p50/p90/p99/max, integer
     microseconds for the *_us stages) pulled out of an admin.metrics
@@ -873,9 +898,166 @@ def _latency_decomposition(metrics_snapshot: dict) -> dict:
     return {k: hists[k] for k in _DECOMPOSITION_STAGES if k in hists}
 
 
+def _e2e_client_main(spec_path: str) -> None:
+    """CLIENT-SUBPROCESS entry (`python bench.py _e2e_client spec.json`):
+    the e2e producer/consumer loadgen, moved OUT of the controller
+    process (ISSUE 12) so client interpreter CPU — codec encode, socket
+    writes, window bookkeeping, ~half of PROFILE.md's measured 28 µs/msg
+    wall — stops being billed to the broker's GIL. One proc runs
+    `threads` windowed producer threads (and the same count of
+    drainers); the parent drives phases over a stdin/stdout line
+    protocol (PRODUCE / DRAIN <phase> / EXIT → RESULT <json>), so
+    process boot and import cost land OUTSIDE every timed window and
+    producer sequence counters persist across phases (count-exactness
+    is cumulative)."""
+    import sys
+    import threading
+    from collections import deque
+
+    from ripplemq_tpu.client.consumer import ConsumerClient
+    from ripplemq_tpu.client.producer import ProducerClient
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    bootstrap = spec["bootstrap"]
+    threads = int(spec["threads"])
+    batch = int(spec["batch"])
+    window = int(spec["window"])
+    duration_s = float(spec["duration_s"])
+    partitions = int(spec["partitions"])
+    read_batch = int(spec["read_batch"])
+    proc_id = int(spec["proc_id"])
+    nprocs = int(spec["nprocs"])
+    total_threads = nprocs * threads
+
+    pc = ProducerClient(bootstrap, rpc_timeout_s=120.0)
+    seqs = [0] * threads
+
+    def produce_phase() -> dict:
+        counts: dict = {}
+        errors: list = []
+        t0 = time.monotonic()
+        stop_at = t0 + duration_s
+
+        def producer(tid: int) -> None:
+            try:
+                _producer(tid)
+            except Exception as e:  # a dead thread must FAIL the
+                errors.append((tid, repr(e)))  # bench, not deflate it
+
+        def _producer(tid: int) -> None:
+            acked = nbytes = 0
+            seq = seqs[tid]
+            gtid = proc_id * threads + tid  # global payload namespace
+            pending: deque = deque()
+
+            def land(w, n, nb):
+                nonlocal acked, nbytes
+                w()
+                acked += n
+                nbytes += nb
+
+            while time.monotonic() < stop_at:
+                while len(pending) >= window:
+                    land(*pending.popleft())
+                payloads = []
+                for _ in range(batch):
+                    head = b"e2e-%d-%08d|" % (gtid, seq)
+                    seq += 1
+                    payloads.append(head.ljust(100, b"x"))
+                nb = sum(map(len, payloads))
+                w = pc.produce_batch_async("bench", payloads)
+                pending.append((w, batch, nb))
+            while pending:
+                land(*pending.popleft())
+            seqs[tid] = seq
+            counts[tid] = (acked, nbytes)
+
+        workers = [
+            threading.Thread(target=producer, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        secs = time.monotonic() - t0
+        if errors:
+            raise AssertionError(f"producer threads failed: {errors}")
+        assert len(counts) == threads
+        return {"acked": sum(v[0] for v in counts.values()),
+                "nbytes": sum(v[1] for v in counts.values()),
+                "secs": secs}
+
+    def drain_phase(phase: int) -> dict:
+        drained = [0] * threads
+        dbytes = [0] * threads
+        warmups = [0] * threads
+        cerrors: list = []
+
+        def drainer(tid: int) -> None:
+            gtid = proc_id * threads + tid
+            cc = ConsumerClient(bootstrap, f"e2e-drain-{phase}-{gtid}",
+                                max_messages=read_batch,
+                                rpc_timeout_s=60.0, prefetch=1)
+            try:
+                for p in range(gtid, partitions, total_threads):
+                    while True:
+                        msgs, _, _, _ = cc.consume_with_position(
+                            "bench", partition=p)
+                        if not msgs:
+                            break  # commit-bounded: caught up
+                        drained[tid] += len(msgs)
+                        dbytes[tid] += sum(map(len, msgs))
+                        warmups[tid] += sum(
+                            m.startswith(b"e2e-warmup") for m in msgs
+                        )
+            except Exception as e:  # a dead drainer FAILS the bench
+                cerrors.append((tid, repr(e)))
+            finally:
+                cc.close()
+
+        drainers = [
+            threading.Thread(target=drainer, args=(i,), daemon=True)
+            for i in range(threads)
+        ]
+        ct0 = time.monotonic()
+        for d in drainers:
+            d.start()
+        for d in drainers:
+            d.join()
+        csecs = time.monotonic() - ct0
+        if cerrors:
+            raise AssertionError(f"consumer threads failed: {cerrors}")
+        return {"drained": sum(drained), "dbytes": sum(dbytes),
+                "warmups": sum(warmups), "secs": csecs}
+
+    print("READY", flush=True)
+    try:
+        for line in sys.stdin:
+            cmd = line.split()
+            if not cmd:
+                continue
+            if cmd[0] == "PRODUCE":
+                res = produce_phase()
+            elif cmd[0] == "DRAIN":
+                res = drain_phase(int(cmd[1]))
+            elif cmd[0] == "EXIT":
+                break
+            else:
+                raise AssertionError(f"unknown command {cmd!r}")
+            print("RESULT " + json.dumps(res), flush=True)
+    except Exception as e:
+        print("ERROR " + repr(e), flush=True)
+        raise
+    finally:
+        pc.close()
+
+
 def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
              threads: int = 8, batch: int = 512, window: int = 16,
-             phases: int = 2, obs: bool = True) -> dict:
+             phases: int = 2, obs: bool = True, host_workers: int = 1,
+             client_procs: int = 2) -> dict:
     """END-TO-END produce throughput: fresh, distinct payloads streamed
     by real producer clients through TCP sockets, broker dispatch, the
     DataPlane batcher, device quorum rounds, the round store, AND the
@@ -898,15 +1080,19 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
     mostly fixed (PROFILE.md "host path"), so throughput is set by how
     many batches each dispatch can carry; a shallow window measures the
     client's window, not the broker. The figure remains a low-core-host
-    floor, not a ceiling, for real deployments."""
+    floor, not a ceiling, for real deployments.
+
+    The producer/consumer clients run in `client_procs` SUBPROCESSES
+    (`_e2e_client_main`) so their interpreter CPU never shares the
+    controller's GIL; `host_workers` > 1 additionally boots the
+    multi-core host plane on every broker (the host_plane_scaling
+    sweep's axis)."""
     import os
     import shutil
     import socket
     import subprocess
     import sys
     import tempfile
-    import threading
-    from collections import deque
 
     import yaml
 
@@ -921,7 +1107,7 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
         s.close()
 
     partitions = 1024
-    raw = e2e_raw_config(ports, partitions)
+    raw = e2e_raw_config(ports, partitions, host_workers=host_workers)
     raw["obs"] = obs  # telemetry A/B knob (PROFILE.md overhead table)
     tmp = tempfile.mkdtemp(prefix="rmq-e2e-")
     config = parse_cluster_config(raw)
@@ -989,17 +1175,67 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
             wt.join(timeout=600)
         pc = ProducerClient(bootstrap, rpc_timeout_s=120.0)
         pc.produce_batch("bench", [b"e2e-warmup"] * 8)
+        pc.close()
         dp = controller.dataplane
+        standby_procs = list(procs)
+        cpu_self0 = _proc_cpu_s(os.getpid())
+
+        # CLIENT SUBPROCESSES (ISSUE 12): the producer/consumer loadgen
+        # runs in `client_procs` dedicated processes (`python bench.py
+        # _e2e_client spec.json`, a jax-free import chain) so client
+        # interpreter CPU — codec encode, socket writes, window
+        # bookkeeping — stops sharing the controller's GIL. Before this
+        # split the clients' ~half of the measured 28 µs/msg host wall
+        # was billed straight to the broker (PROFILE.md round 12 has the
+        # measured delta). The parent drives phases over a line
+        # protocol; boot/import cost lands outside every timed window.
+        tpp = max(1, threads // max(1, client_procs))
+        clients = []
+        for i in range(client_procs):
+            spec = {
+                "bootstrap": bootstrap, "proc_id": i,
+                "nprocs": client_procs, "threads": tpp,
+                "batch": batch, "window": window,
+                "duration_s": duration_s, "partitions": partitions,
+                "read_batch": raw["engine"]["read_batch"],
+            }
+            spec_path = os.path.join(tmp, f"client{i}.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
+            c = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "_e2e_client", spec_path],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True, bufsize=1,
+            )
+            clients.append(c)
+            procs.append(c)  # the teardown path covers a failed run
+
+        def _expect(c, tag: str) -> str:
+            line = (c.stdout.readline() or "").strip()
+            assert line.startswith(tag), (
+                f"e2e client answered {line!r}, wanted {tag}"
+            )
+            return line[len(tag):].strip()
+
+        for c in clients:
+            _expect(c, "READY")
+
+        def client_phase(cmd: str) -> list[dict]:
+            for c in clients:
+                c.stdin.write(cmd + "\n")
+                c.stdin.flush()
+            return [json.loads(_expect(c, "RESULT ")) for c in clients]
 
         # Best-of-N phases: produce window then full drain, repeated.
         # Same methodology as _run_sustained's best-of-N windows —
         # additive noise (this class of bench host shows >2x run-to-run
         # swings from hypervisor scheduling) only ever slows a phase, so
         # per-phase maxima bound the system's actual capacity. Counts
-        # stay exact across phases: sequences continue, and every drain
-        # re-reads the FULL topic from offset 0 under fresh consumer
-        # ids, so phase k's drain must equal the cumulative ack count.
-        seqs = [0] * threads
+        # stay exact across phases: sequences continue (in each client
+        # proc's memory), and every drain re-reads the FULL topic from
+        # offset 0 under fresh consumer ids, so phase k's drain must
+        # equal the cumulative ack count.
         acked_total = 0
         nbytes_total = 0
         best_produce = (0.0, 0.0)  # (appends/s, MB/s)
@@ -1008,108 +1244,14 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
         consumed_final = 0
         produce_secs = 0.0
 
-        def produce_phase() -> tuple[int, int, float]:
-            counts = {}
-            errors: list = []
-            t0 = time.monotonic()
-            stop_at = t0 + duration_s
-
-            def producer(tid: int) -> None:
-                try:
-                    _producer(tid)
-                except Exception as e:  # a dead thread must FAIL the
-                    errors.append((tid, repr(e)))  # bench, not deflate it
-
-            def _producer(tid: int) -> None:
-                acked = nbytes = 0
-                seq = seqs[tid]
-                pending: deque = deque()
-
-                def land(w, n, nb):
-                    nonlocal acked, nbytes
-                    w()
-                    acked += n
-                    nbytes += nb
-
-                while time.monotonic() < stop_at:
-                    while len(pending) >= window:
-                        land(*pending.popleft())
-                    payloads = []
-                    for _ in range(batch):
-                        head = b"e2e-%d-%08d|" % (tid, seq)
-                        seq += 1
-                        payloads.append(head.ljust(100, b"x"))
-                    nb = sum(map(len, payloads))
-                    w = pc.produce_batch_async("bench", payloads)
-                    pending.append((w, batch, nb))
-                while pending:
-                    land(*pending.popleft())
-                seqs[tid] = seq
-                counts[tid] = (acked, nbytes)
-
-            workers = [
-                threading.Thread(target=producer, args=(i,), daemon=True)
-                for i in range(threads)
-            ]
-            for w in workers:
-                w.start()
-            for w in workers:
-                w.join()
-            secs = time.monotonic() - t0
-            assert not errors, f"producer threads failed: {errors}"
-            assert len(counts) == threads
-            return (sum(v[0] for v in counts.values()),
-                    sum(v[1] for v in counts.values()), secs)
-
-        def drain_phase(phase: int) -> tuple[int, int, int, float]:
-            # END-TO-END consume: real consumer clients over TCP drain
-            # the WHOLE topic — socket → dispatch → host-mirror/store
-            # read → codec, with prefetch=1 keeping the next window's
-            # fetch in flight and the auto-commit quorum rounds
-            # pipelined behind the drain instead of gating it
-            # (client/consumer.py readahead; the reference's consume
-            # path is socket-to-socket too, ConsumerClientImpl.java).
-            drained = [0] * threads
-            dbytes = [0] * threads
-            warmups = [0] * threads
-            cerrors: list = []
-
-            def drainer(tid: int) -> None:
-                cc = ConsumerClient(bootstrap, f"e2e-drain-{phase}-{tid}",
-                                    max_messages=raw["engine"]["read_batch"],
-                                    rpc_timeout_s=60.0, prefetch=1)
-                try:
-                    for p in range(tid, partitions, threads):
-                        while True:
-                            msgs, _, _, _ = cc.consume_with_position(
-                                "bench", partition=p)
-                            if not msgs:
-                                break  # commit-bounded: caught up
-                            drained[tid] += len(msgs)
-                            dbytes[tid] += sum(map(len, msgs))
-                            warmups[tid] += sum(
-                                m.startswith(b"e2e-warmup") for m in msgs
-                            )
-                except Exception as e:  # a dead drainer FAILS the bench
-                    cerrors.append((tid, repr(e)))
-                finally:
-                    cc.close()
-
-            drainers = [
-                threading.Thread(target=drainer, args=(i,), daemon=True)
-                for i in range(threads)
-            ]
-            ct0 = time.monotonic()
-            for d in drainers:
-                d.start()
-            for d in drainers:
-                d.join()
-            csecs = time.monotonic() - ct0
-            assert not cerrors, f"consumer threads failed: {cerrors}"
-            return sum(drained), sum(dbytes), sum(warmups), csecs
-
         for phase in range(max(1, phases)):
-            acked, nbytes, secs = produce_phase()
+            # The phase window is each client's own measured duration;
+            # the clients start within the protocol write loop (~ms
+            # skew), so max() is the honest concurrent-window length.
+            outs = client_phase("PRODUCE")
+            acked = sum(o["acked"] for o in outs)
+            nbytes = sum(o["nbytes"] for o in outs)
+            secs = max(o["secs"] for o in outs)
             assert acked > 0
             acked_total += acked
             nbytes_total += nbytes
@@ -1118,7 +1260,16 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
                                (acked / secs, nbytes / secs / 1e6))
             # The controller's committed-entry count must cover every ack.
             assert dp is not None and dp.committed_entries >= acked_total
-            consumed, cbytes, nwarm, csecs = drain_phase(phase)
+            # END-TO-END consume: the client procs' drainer threads pull
+            # the WHOLE topic over TCP — socket → dispatch → host-mirror
+            # (or host-plane worker mirror) read → codec, prefetch=1
+            # keeping the next window's fetch in flight and auto-commits
+            # pipelined behind the drain (client/consumer.py readahead).
+            douts = client_phase(f"DRAIN {phase}")
+            consumed = sum(o["drained"] for o in douts)
+            cbytes = sum(o["dbytes"] for o in douts)
+            nwarm = sum(o["warmups"] for o in douts)
+            csecs = max(o["secs"] for o in douts)
             consume_secs += csecs
             consumed_final = consumed
             # Count honesty: every async-acked append must come back
@@ -1130,7 +1281,46 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
             assert consumed - nwarm == acked_total, (consumed, acked_total)
             best_consume = max(best_consume,
                                (consumed / csecs, cbytes / csecs / 1e6))
-        pc.close()
+
+        # Per-process CPU decomposition (collected while every process
+        # is still alive): where the interpreter seconds of this run
+        # actually landed. `controller` is THIS process minus the
+        # pre-run baseline (boot/warm excluded); worker CPU is listed
+        # apart so the host-plane arms show what moved off the broker's
+        # GIL vs what the extra hop cost.
+        def _child_pids(ppid: int) -> list[int]:
+            import glob
+
+            out = []
+            for st in glob.glob("/proc/[0-9]*/stat"):
+                try:
+                    with open(st, "rb") as f:
+                        rest = f.read().rsplit(b") ", 1)[1].split()
+                    if int(rest[1]) == ppid:
+                        out.append(int(st.split("/")[2]))
+                except Exception:
+                    continue
+            return out
+
+        hp = controller.hostplane
+        cpu_decomp = {
+            "controller_s": round(_proc_cpu_s(os.getpid()) - cpu_self0, 1),
+            "controller_workers_s": round(sum(
+                _proc_cpu_s(p) for p in (hp.worker_pids() if hp else [])
+            ), 1),
+            "standbys_s": round(sum(
+                _proc_cpu_s(p.pid) + sum(_proc_cpu_s(c)
+                                         for c in _child_pids(p.pid))
+                for p in standby_procs
+            ), 1),
+            "clients_s": round(sum(_proc_cpu_s(c.pid) for c in clients), 1),
+        }
+
+        for c in clients:
+            c.stdin.write("EXIT\n")
+            c.stdin.flush()
+        for c in clients:
+            c.wait(timeout=30)
 
         # Readback honesty: consume a window back through the client SDK
         # and check the loadgen payload structure survived byte-exact.
@@ -1167,7 +1357,10 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
             "e2e_appends_per_sec": round(best_produce[0], 1),
             "e2e_mb_per_sec": round(best_produce[1], 2),
             "e2e_acked": acked_total,
-            "e2e_offered_batches": threads * window,
+            "e2e_offered_batches": client_procs * tpp * window,
+            "e2e_client_procs": client_procs,
+            "e2e_host_workers": host_workers,
+            "e2e_cpu_decomposition": cpu_decomp,
             "e2e_phases": max(1, phases),
             "e2e_seconds": round(produce_secs, 1),
             "e2e_readback": "verified",
@@ -1192,6 +1385,45 @@ def _run_e2e(duration_s: float = 12.0, n_brokers: int = 3,
             except subprocess.TimeoutExpired:
                 p.kill()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_host_plane_scaling(worker_counts: tuple[int, ...] = (1, 2, 4),
+                            duration_s: float = 6.0,
+                            phases: int = 2) -> dict:
+    """ISSUE 12 tentpole: same-host worker-count sweep of the multi-core
+    host plane. Each arm runs the FULL e2e topology (subprocess standby
+    brokers, subprocess clients, real TCP) with `host_workers` worker
+    subprocesses per broker — workers=1 is the single-process host path,
+    the pre-PR-12 shape — using the same best-of-N sustained method and
+    the same count-exact readback as the headline e2e phase, in ONE run
+    on one host so the arms share their noise floor. The verdict
+    carries every arm plus scaling_x = best/workers-1; `host_cores`
+    records the parallelism physically available (on a 2-core container
+    the curve prices the plane's overhead, not its headroom — the ≥4-core
+    reading is the refactor's target, PROFILE.md round 12)."""
+    import os
+
+    arms = []
+    for w in worker_counts:
+        r = _run_e2e(duration_s=duration_s, phases=phases, host_workers=w)
+        arms.append({
+            "host_workers": w,
+            "appends_per_sec": r["e2e_appends_per_sec"],
+            "consume_msgs_per_sec": r["e2e_consume_msgs_per_sec"],
+            "acked": r["e2e_acked"],
+            "readback": r["e2e_consume_verified"],
+            "cpu_decomposition": r["e2e_cpu_decomposition"],
+        })
+    base = arms[0]["appends_per_sec"]
+    best = max(arms, key=lambda a: a["appends_per_sec"])
+    return {
+        "arms": arms,
+        "baseline_appends_per_sec": base,
+        "best_workers": best["host_workers"],
+        "best_appends_per_sec": best["appends_per_sec"],
+        "scaling_x": round(best["appends_per_sec"] / base, 2),
+        "host_cores": os.cpu_count(),
+    }
 
 
 def _run_group_consume(n_groups: int = 3, members: int = 2,
@@ -1565,6 +1797,9 @@ def main() -> None:
     # (count-exact per group, shared offsets, generation fencing live).
     group_consume = _run_group_consume()
     e2e = _run_e2e()
+    # ISSUE 12: the multi-core host plane's same-host worker sweep
+    # (workers 1/2/4, subprocess clients everywhere, count-exact).
+    host_plane_scaling = _run_host_plane_scaling()
 
     print(
         json.dumps(
@@ -1593,6 +1828,7 @@ def main() -> None:
                 "repl_bytes_per_acked_byte": repl_bytes,
                 "stripe_encode_mb_per_sec": stripe_encode,
                 "readback": "verified",
+                "host_plane_scaling": host_plane_scaling,
                 **group_consume,
                 **e2e,
             }
@@ -1601,4 +1837,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+
+    if len(_sys.argv) > 2 and _sys.argv[1] == "_e2e_client":
+        # e2e loadgen subprocess (jax-free): see _e2e_client_main.
+        _e2e_client_main(_sys.argv[2])
+    else:
+        main()

@@ -20,10 +20,18 @@ Decomposed terms (all per 256-message batch, the e2e unit of work):
   broker-local map like the reference's PartitionStateMachine.java:27).
 
 Run: python profiles/host_edge.py   (the one real chip; ~2 min)
+     python profiles/host_edge.py --host-workers 2
+        # boot the multi-core host plane (parallel/hostplane.py) and
+        # add the worker-hop terms: the shared-memory ring round trip
+        # (validate + stamp + pack in the worker subprocess) and the
+        # produce RPC measured THROUGH the worker path — the ISSUE 12
+        # decomposition of what the extra hop costs vs what it moves
+        # off the broker's GIL.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import shutil
@@ -60,6 +68,12 @@ def _ok(resp: dict) -> dict:
 
 
 def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host-workers", type=int, default=1,
+                    help="boot the multi-core host plane and add the "
+                         "worker-hop terms to the decomposition")
+    args = ap.parse_args()
+
     from ripplemq_tpu.broker.server import BrokerServer
     from ripplemq_tpu.core.encode import pack_payload_rows
     from ripplemq_tpu.metadata.cluster_config import parse_cluster_config
@@ -76,7 +90,7 @@ def main() -> None:
         s.close()
     # THE e2e topology (shared helper): the decomposition must measure
     # the same shape the bench runs, or the two silently drift.
-    raw = bench.e2e_raw_config(ports)
+    raw = bench.e2e_raw_config(ports, host_workers=args.host_workers)
     payloads = [b"edge-%08d|" % i + b"x" * 86 for i in range(256)]
     produce_req = {"type": "produce", "topic": "bench", "partition": 0,
                    "messages": payloads}
@@ -131,8 +145,21 @@ def main() -> None:
             lambda: dp.submit_append(0, [payloads[0]]).result(timeout=60), 24)
 
         # --- full produce RPC (socket + codec + dispatch + engine) -------
+        # With --host-workers this path runs THROUGH the worker: ring
+        # round trip (validate + stamp + pack in the subprocess) +
+        # submit_packed, so the delta vs the workers=1 run prices the
+        # hop the multi-core plane adds to one serial ack (what it buys
+        # is concurrency, which this serial probe cannot see — the
+        # host_plane_scaling bench phase measures that side).
         out["produce_rpc256_ms"] = _t(
             lambda: _ok(client.call(addr, produce_req, timeout=60.0)), 24)
+        if controller.hostplane is not None:
+            out["host_workers"] = args.host_workers
+            # The worker hop alone: shared-memory ring round trip
+            # carrying the 256-message batch out and the packed
+            # [256, slot_bytes] row block back.
+            out["worker_submit256_ms"] = _t(
+                lambda: controller.hostplane.submit(0, payloads), 40)
 
         # --- consume side -------------------------------------------------
         reg = client.call(addr, {"type": "consume", "topic": "bench",

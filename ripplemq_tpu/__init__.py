@@ -33,16 +33,6 @@ Architecture (TPU-first, not a translation):
 
 __version__ = "0.1.0"
 
-from ripplemq_tpu.core import (  # noqa: E402
-    EngineConfig,
-    ReplicaState,
-    StepInput,
-    StepOutput,
-    build_step_input,
-    decode_entries,
-    init_state,
-)
-
 __all__ = [
     "EngineConfig",
     "ReplicaState",
@@ -52,3 +42,17 @@ __all__ = [
     "decode_entries",
     "init_state",
 ]
+
+
+def __getattr__(name):
+    # Lazy re-exports (PEP 562): importing the package must not pull
+    # jax. The multi-core host plane SPAWNS worker subprocesses whose
+    # import chain runs through this module — an eager `from
+    # ripplemq_tpu.core import ...` charged every worker boot (and
+    # every client-only import) the full ~4 s jax initialization for
+    # symbols the worker never touches.
+    if name in __all__:
+        from ripplemq_tpu import core
+
+        return getattr(core, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -43,6 +43,8 @@ FAST_MODULES = {
     "test_failover",
     "test_graft",
     "test_groups",              # ~30 s: coordinator units + one cluster run
+    "test_hostplane",           # ~15 s: worker spawns are jax-free (~100 ms)
+    "test_hostplane_chaos",     # ~35 s: one seeded run + prefix parity
     "test_hostraft",
     "test_idempotence",         # ~25 s: dedup units + failover replay
     "test_linearizable_reads",  # ~25 s: staged stale-controller clusters
@@ -66,10 +68,12 @@ FAST_MODULES = {
     "test_settle_pipeline",
     "test_settled_gap",
     "test_term_skew",
+    "test_repl_pipeline",       # ~6 s: stub-client sender window units
     "test_retention",
     "test_retry_policy",
     "test_rs",
     "test_shard_distribution",
+    "test_shmring",             # ~5 s: in-process ring framing units
     "test_soak",                # ~15 s: the bounded hand-written soak
     "test_spmd",
     "test_storage",

@@ -304,6 +304,15 @@ class DataPlane:
         # (FencedError ⊂ NotCommittedError → producers retry at the new
         # controller).
         self.replicate_fn = replicate_fn
+        # Host-plane settled-mirror hook (parallel/hostplane.py): when
+        # the broker runs worker subprocesses, the settle thread
+        # publishes each durably-settled round's REC_APPEND rows to the
+        # owning worker so consume reads for that slice are served off
+        # this process's GIL. Fire-and-forget BY CONTRACT — the hook
+        # must never block settle (HostPlane.publish drops on a full
+        # ring; the worker's contiguity check turns drops into clean
+        # engine-read fallbacks).
+        self.mirror_fn = None
         # Pipelined-settle split of replicate_fn (RoundReplicator.begin/
         # wait): `begin` enqueues a round's records on every standby
         # stream without blocking; `wait` blocks until all member acks.
@@ -865,6 +874,50 @@ class DataPlane:
                 TypeError(f"payloads must be bytes: {e}")
             )
             return fut
+        return self._submit_rows(slot, list(payloads), rows, pid, seq, fut)
+
+    def submit_packed(self, slot: int, packed, lens: list[int],
+                      pid: int = 0, seq: int = -1) -> Future:
+        """Queue a PRE-PACKED append batch: `packed` is the
+        `[len(lens), slot_bytes]` row block a host-plane worker already
+        validated and packed (parallel/hostplane.py `_pack_rows`, the
+        byte-identical twin of pack_payload_rows) — the payload bytes
+        cross this boundary once and are never re-encoded. Semantics
+        are submit_append's exactly; validation here is only the cheap
+        structural re-check (the block shape), since the worker ran the
+        per-message checks where packing ran."""
+        fut: Future = Future()
+        cfg = self.cfg
+        SB = cfg.slot_bytes
+        k = len(lens)
+        if not 0 <= slot < cfg.partitions:
+            fut.set_exception(ValueError(f"partition slot {slot} out of range"))
+            return fut
+        if k == 0 or k > cfg.max_batch or len(packed) != k * SB:
+            fut.set_exception(ValueError(
+                f"packed block of {len(packed)} bytes does not hold "
+                f"{k} rows of {SB} (max_batch {cfg.max_batch})"
+            ))
+            return fut
+        if k and (min(lens) <= 0 or max(lens) > cfg.payload_bytes):
+            fut.set_exception(ValueError(
+                f"packed row lengths out of (0, {cfg.payload_bytes}]"
+            ))
+            return fut
+        rows = np.frombuffer(packed, np.uint8).reshape(k, SB)
+        # Zero-copy payload views into the block (the drain only ever
+        # len()s and persists them; the block itself is what rides the
+        # round).
+        mv = memoryview(packed)
+        payloads = [
+            mv[i * SB + _HDR : i * SB + _HDR + lens[i]] for i in range(k)
+        ]
+        return self._submit_rows(slot, payloads, rows, pid, seq, fut)
+
+    def _submit_rows(self, slot: int, payloads: list, rows,
+                     pid: int, seq: int, fut: Future) -> Future:
+        """Shared enqueue tail of submit_append / submit_packed (the
+        caller validated and packed)."""
         self._m_submits.inc()
         self._m_messages.inc(len(payloads))
         pid, seq = int(pid), int(seq)
@@ -2196,6 +2249,11 @@ class DataPlane:
             # settled-gap structure remains the full fix if soaks flag
             # it.)
             self._mirror_records(records)
+            mirror_fn = self.mirror_fn
+            if mirror_fn is not None:
+                for rec_type, slot, base, payload in records:
+                    if rec_type == REC_APPEND:
+                        mirror_fn(slot, base, payload)
             with self._lock:
                 for k, rc in enumerate(chain):
                     for slot in rc["appends"]:

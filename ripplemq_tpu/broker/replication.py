@@ -181,126 +181,233 @@ class _Sender(threading.Thread):
 
     # -- send loop --
 
+    def _take_group(self) -> Optional[list]:
+        """Pop one bounded group-commit [(records, fut), ...] off the
+        queue (caller holds self._cond)."""
+        if not self._queue:
+            return None
+        group = [self._queue.pop(0)]
+        nbytes = sum(len(r[3]) for r in group[0][0])
+        while (self._queue and len(group) < _GROUP_COMMIT_ROUNDS
+               and nbytes < _GROUP_COMMIT_BYTES):
+            recs, _ = self._queue[0]
+            nbytes += sum(len(r[3]) for r in recs)
+            group.append(self._queue.pop(0))
+        return group
+
+    @staticmethod
+    def _settle_group(group: list, result) -> None:
+        for _, f in group:
+            if not f.done():
+                if isinstance(result, BaseException):
+                    f.set_exception(result)
+                else:
+                    f.set_result(result)
+
+    def _send_frame(self, group: list, epoch: int, sseq: int):
+        """Fire one epoch-stamped, stream-sequenced repl.rounds frame;
+        returns a Future of the response dict (pipelined when the
+        transport supports call_async, an already-resolved future
+        otherwise — the in-proc network is synchronous by design)."""
+        records = [r for recs, _ in group for r in recs]
+        req = {
+            "type": "repl.rounds",
+            "epoch": epoch,
+            "sender": self._rep.sender_id,
+            "sseq": sseq,
+            "records": [[t, s, b, p] for t, s, b, p in records],
+        }
+        call_async = getattr(self._rep.client, "call_async", None)
+        if call_async is not None:
+            return call_async(self._rep.addr_of(self.broker_id), req)
+        fut: Future = Future()
+        try:
+            fut.set_result(self._rep.client.call(
+                self._rep.addr_of(self.broker_id), req,
+                timeout=self._rep.rpc_timeout_s,
+            ))
+        except Exception as e:
+            fut.set_exception(e)
+        return fut
+
     def run(self) -> None:
+        """PIPELINED group-commit stream: up to `pipeline_depth`
+        epoch-stamped frames in flight, each carrying a per-stream
+        sequence number (`sseq`) the standby's stream gate applies in
+        order (BrokerServer._handle_repl_rounds). This is what kills
+        the PR 3 sender's head-of-line blocking: one slow ack used to
+        cap the stream at one group per round trip — now later groups
+        are already on the wire (and applied, in sseq order) while the
+        oldest ack is outstanding; acks still release in order here.
+        On ANY failure the whole in-flight window rewinds: un-acked
+        groups requeue at the head in order and re-send under their
+        ORIGINAL sseqs — a frame that did apply before the failure is
+        re-applied harmlessly (duplicate records are later-record-wins
+        at replay; the gate acks `sseq < expected` after re-applying)."""
         backoff = 0.05
         failures = 0
-        while True:
+        next_sseq = 0
+        # In-flight window entries: [group, sseq, rpc_fut, t_frame].
+        inflight: list = []
+
+        def fail_inflight(result) -> None:
+            while inflight:
+                self._settle_group(inflight.pop(0)[0], result)
+
+        def rewind_inflight(reset_to=None) -> None:
+            """Requeue every un-acked in-flight group (head, in order)
+            for a re-send under its original sseq — or under the
+            standby's advertised `expected` counter (`reset_to`, from a
+            repl_seq_gap refusal): a RESTARTED standby's gate restarts
+            at zero, and re-sending under the old numbering would gap
+            forever. Renumbering is safe — frame content never depends
+            on its sseq."""
+            nonlocal next_sseq
+            if not inflight:
+                return
+            next_sseq = (int(reset_to) if reset_to is not None
+                         else inflight[0][1])
             with self._cond:
-                while not self._queue and not self._stopped:
+                self._queue[0:0] = [
+                    pair for entry in inflight for pair in entry[0]
+                ]
+            inflight.clear()
+
+        while True:
+            depth = max(1, int(self._rep.pipeline_depth))
+            with self._cond:
+                while (not self._queue and not inflight
+                       and not self._stopped):
                     self._cond.wait(timeout=0.2)
                 if self._stopped:
-                    return
-                # GROUP COMMIT: take the whole queued backlog (bounded)
-                # into ONE epoch-stamped RPC — order within the frame is
-                # queue order, so the standby applies the same record
-                # stream, just in fewer round trips. All grouped rounds
-                # ack (or fail) together; a retry re-sends the whole
-                # group, which replay's later-record-wins absorbs
-                # exactly like any duplicated round.
-                group = [self._queue.pop(0)]
-                nbytes = sum(len(r[3]) for r in group[0][0])
-                while (self._queue and len(group) < _GROUP_COMMIT_ROUNDS
-                       and nbytes < _GROUP_COMMIT_BYTES):
-                    recs, _ = self._queue[0]
-                    nbytes += sum(len(r[3]) for r in recs)
-                    group.append(self._queue.pop(0))
-            records = [r for recs, _ in group for r in recs]
-            futs = [f for _, f in group]
-
-            def settle_all(result) -> None:
-                for f in futs:
-                    if not f.done():
-                        if isinstance(result, BaseException):
-                            f.set_exception(result)
-                        else:
-                            f.set_result(result)
-
-            while True:
-                if self._stopped:
-                    settle_all(ReplicationError("sender stopped"))
                     break
-                if not self._rep.active():
-                    settle_all(
-                        FencedError("controller deposed (local metadata)")
-                    )
-                    break
+                groups = []
+                while len(inflight) + len(groups) < depth:
+                    g = self._take_group()
+                    if g is None:
+                        break
+                    groups.append(g)
+            # -- fire new frames (top up the window) --
+            fenced = False
+            for group in groups:
                 # Epoch is stamped ONCE per delivery attempt from the
-                # ACTIVE view — the active() check above just passed, so
-                # this is the epoch we legitimately stream under. It
-                # must never be re-read after a deposition: a deposed
-                # sender re-stamping its stale backlog with the NEW
-                # epoch would walk it straight through the standby's
-                # fence (the seeded chaos soak caught that as an acked
-                # produce the promoted controller had never seen).
+                # ACTIVE view. It must never be re-read after a
+                # deposition: a deposed sender re-stamping its stale
+                # backlog with the NEW epoch would walk it straight
+                # through the standby's fence (the seeded chaos soak
+                # caught that as an acked produce the promoted
+                # controller had never seen). The double-check closes
+                # the check/stamp race.
+                if fenced or not self._rep.active():
+                    fenced = True
+                    self._settle_group(
+                        group,
+                        FencedError("controller deposed (local metadata)"),
+                    )
+                    continue
                 epoch = self._rep.epoch_fn()
                 if not self._rep.active():
-                    # Deposed between the check and the stamp: the epoch
-                    # read may be the successor's. Refuse the round.
-                    settle_all(
+                    fenced = True
+                    self._settle_group(
+                        group,
+                        FencedError("controller deposed (local metadata)"),
+                    )
+                    continue
+                t_frame = (self._rep._clock()
+                           if self._rep._h_frame_us is not None else 0.0)
+                inflight.append(
+                    [group, next_sseq,
+                     self._send_frame(group, epoch, next_sseq), t_frame,
+                     time.monotonic()]
+                )
+                next_sseq += 1
+            if not inflight:
+                continue
+            # -- wait on the OLDEST in-flight frame --
+            group, sseq, rpc_fut, t_frame, t_sent = inflight[0]
+            try:
+                resp = rpc_fut.result(timeout=0.1)
+            except (TimeoutError, FuturesTimeoutError):
+                if self._stopped:
+                    fail_inflight(ReplicationError("sender stopped"))
+                    return
+                if not self._rep.active():
+                    fail_inflight(
                         FencedError("controller deposed (local metadata)")
                     )
-                    break
-                rep_h = self._rep._h_frame_us
-                t_frame = self._rep._clock() if rep_h is not None else 0.0
-                try:
-                    resp = self._rep.client.call(
-                        self._rep.addr_of(self.broker_id),
-                        {
-                            "type": "repl.rounds",
-                            "epoch": epoch,
-                            "records": [
-                                [t, s, b, p] for t, s, b, p in records
-                            ],
-                        },
-                        timeout=self._rep.rpc_timeout_s,
-                    )
-                except RpcError:
+                    continue
+                if time.monotonic() - t_sent > self._rep.rpc_timeout_s:
+                    # call_async carries no transport deadline: a hung
+                    # (connected but unresponsive) standby must hit the
+                    # same rpc-timeout retry path the synchronous
+                    # sender had — rewind and re-send; the duplicate
+                    # delivery, if the first one eventually lands, is
+                    # absorbed like any other (gate dup path).
                     failures += 1
                     if self._rep._c_retries is not None:
                         self._rep._c_retries.inc()
                     if failures >= 3:
                         self.unreachable = True
+                    rewind_inflight()
                     time.sleep(min(0.5, backoff * failures))
-                    continue
+                continue
+            except RpcError:
+                failures += 1
+                if self._rep._c_retries is not None:
+                    self._rep._c_retries.inc()
+                if failures >= 3:
+                    self.unreachable = True
+                rewind_inflight()
+                time.sleep(min(0.5, backoff * failures))
+                continue
+            if resp.get("ok"):
+                inflight.pop(0)
                 failures = 0
                 self.unreachable = False
-                if resp.get("ok"):
-                    # Group-commit telemetry: rounds per acked frame is
-                    # the batching factor the PR 3 sender bought; the
-                    # frame RPC time is the raw standby round trip the
-                    # settle stage's standby_ack_us overlaps away.
-                    if self._rep._h_group is not None:
-                        self._rep._h_group.observe_int(len(futs))
-                        self._rep._h_frame_us.observe(
-                            self._rep._clock() - t_frame
-                        )
-                        self._rep._c_records.inc(len(records))
-                        self._rep._c_frames.inc()
-                        self._rep._c_bytes.inc(
-                            sum(len(r[3]) for r in records)
-                        )
-                    log.debug("standby %d acked %d records (%d rounds) at "
-                              "epoch %d", self.broker_id, len(records),
-                              len(futs), epoch)
-                    settle_all(True)
-                    break
-                if resp.get("error") == "stale_epoch":
-                    settle_all(FencedError("standby reports newer epoch"))
-                    break
-                if resp.get("error") == "store_quarantined":
-                    # The standby quarantined its store (reopened empty)
-                    # and is refusing acks under its stale pre-death
-                    # membership. Flag it suspect NOW — waiting out the
-                    # full ack timeout just stalls every round in the
-                    # window — so the duty loop prunes it from the set;
-                    # the ordinary standby-add then re-admits it through
-                    # the full catch-up stream, after which it acks again.
-                    with self._rep._lock:
-                        self._rep._suspects.add(self.broker_id)
-                # Transient standby-side refusal (e.g. it believes itself
-                # the active controller until its fence duty runs): retry.
-                failures += 1
-                time.sleep(min(0.5, backoff * failures))
+                records = [r for recs, _ in group for r in recs]
+                # Group-commit telemetry: rounds per acked frame is the
+                # batching factor the PR 3 sender bought; the frame RPC
+                # time is the raw standby round trip the settle stage's
+                # standby_ack_us overlaps away (and pipelining overlaps
+                # across frames too).
+                if self._rep._h_group is not None:
+                    self._rep._h_group.observe_int(len(group))
+                    self._rep._h_frame_us.observe(
+                        self._rep._clock() - t_frame
+                    )
+                    self._rep._c_records.inc(len(records))
+                    self._rep._c_frames.inc()
+                    self._rep._c_bytes.inc(sum(len(r[3]) for r in records))
+                log.debug("standby %d acked %d records (%d rounds, sseq "
+                          "%d)", self.broker_id, len(records), len(group),
+                          sseq)
+                self._settle_group(group, True)
+                continue
+            if resp.get("error") == "stale_epoch":
+                fail_inflight(FencedError("standby reports newer epoch"))
+                continue
+            if resp.get("error") == "store_quarantined":
+                # The standby quarantined its store (reopened empty)
+                # and is refusing acks under its stale pre-death
+                # membership. Flag it suspect NOW — waiting out the
+                # full ack timeout just stalls every round in the
+                # window — so the duty loop prunes it from the set;
+                # the ordinary standby-add then re-admits it through
+                # the full catch-up stream, after which it acks again.
+                with self._rep._lock:
+                    self._rep._suspects.add(self.broker_id)
+            # Transient standby-side refusal (active_controller until
+            # its fence duty runs, a repl_seq_gap after wire loss):
+            # rewind the window and retry in order.
+            failures += 1
+            reset = None
+            if str(resp.get("error", "")).startswith("repl_seq_gap"):
+                reset = resp.get("expected")
+            rewind_inflight(reset)
+            time.sleep(min(0.5, backoff * failures))
+        # Stopped: nothing in flight may settle (stop() already failed
+        # the queued backlog; in-flight rounds must fail the same way).
+        fail_inflight(ReplicationError("sender stopped"))
 
 
 class RoundReplicator:
@@ -321,6 +428,8 @@ class RoundReplicator:
         rpc_timeout_s: float = 3.0,
         ack_timeout_s: float = 5.0,
         metrics=None,
+        sender_id: int = -1,
+        pipeline_depth: int = 1,
     ) -> None:
         self.client = client
         self.addr_of = addr_of
@@ -329,6 +438,11 @@ class RoundReplicator:
         self.active = active_fn
         self.rpc_timeout_s = rpc_timeout_s
         self.ack_timeout_s = ack_timeout_s
+        # Stream identity + window for the pipelined sender (_Sender.run):
+        # (sender_id, epoch) keys the standby's per-stream sequence gate,
+        # pipeline_depth bounds the frames in flight per stream.
+        self.sender_id = int(sender_id)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         # Sender-side group-commit telemetry (obs.Metrics, usually the
         # owning broker's registry). None or a disabled registry → the
         # handles stay None and the send loop skips the clock reads too.

@@ -138,6 +138,72 @@ class _BarrierGate:
             fut.set_exception(e)
 
 
+class _ReplStreamGate:
+    """Per-(sender, epoch) IN-ORDER application gate for the pipelined
+    replication stream (broker/replication.py _Sender): the sender
+    keeps `repl_pipeline_depth` frames in flight, each stamped with a
+    per-stream sequence number, and concurrent RPC worker threads may
+    decode them out of order — this gate serializes APPLICATION to
+    sequence order without giving up the pipelining (successors park
+    briefly instead of bouncing). `enter` returns "apply" for the
+    in-order frame and for any DUPLICATE (sseq below expected: a
+    rewound sender re-sends frames whose first delivery may already
+    have applied — re-application is harmless, replay is
+    later-record-wins), or "gap" when predecessors never arrive inside
+    the wait (wire loss): the handler refuses with `repl_seq_gap` +
+    the expected counter and the sender rewinds onto it — which also
+    re-syncs a RESTARTED standby whose gate restarted at zero."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("_ReplStreamGate._lock")
+        self._cond = threading.Condition(self._lock)
+        self._expected: dict[tuple, int] = {}
+
+    def expected(self, key: tuple) -> int:
+        with self._cond:
+            return self._expected.get(key, 0)
+
+    def enter(self, key: tuple, sseq: int, timeout_s: float = 1.0) -> bool:
+        """Block until `sseq` is applicable; False = sequence gap."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            if key not in self._expected:
+                # New (sender, epoch) stream: retire the sender's older
+                # epochs (the dict must not grow with failovers).
+                for k in [k for k in self._expected
+                          if k[0] == key[0] and k[1] < key[1]]:
+                    del self._expected[k]
+                self._expected[key] = 0
+            while True:
+                # .get, not []: a newer-epoch frame for the same sender
+                # retires this key while we park — the woken thread
+                # must answer "gap" (the sender's old-epoch rewind hits
+                # the stale_epoch fence anyway), not KeyError out of
+                # the handler.
+                cur = self._expected.get(key)
+                if cur is None:
+                    return False
+                if sseq <= cur:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+
+    def applied(self, key: tuple, sseq: int) -> None:
+        """Mark `sseq` durably applied; wakes parked successors. Only
+        called on success — a failed apply leaves `expected` in place
+        so the sender's rewind re-delivers."""
+        with self._cond:
+            cur = self._expected.get(key)
+            # A retired (newer epoch arrived mid-apply) stream must not
+            # be resurrected here — the entry would leak until the next
+            # same-sender retirement.
+            if cur is not None and sseq + 1 > cur:
+                self._expected[key] = sseq + 1
+            self._cond.notify_all()
+
+
 class BrokerServer:
     """One broker. `net` is an InProcNetwork for single-process clusters
     (tests, single-chip deployments) or None for real TCP sockets."""
@@ -324,13 +390,44 @@ class BrokerServer:
         import uuid as _uuid
 
         self._broker_pid: Optional[int] = None
+        # Per-boot nonce shared by the broker stamping pid AND the
+        # host-plane workers' per-(worker, generation) pids: restarts
+        # and worker respawns must never reuse a pid whose sequence
+        # counters they lost (_worker_pid_duty).
+        self._pid_nonce = _uuid.uuid4().hex[:12]
         self._broker_pid_name = (
-            f"_broker/{broker_id}/{_uuid.uuid4().hex[:12]}"
+            f"_broker/{broker_id}/{self._pid_nonce}"
         )
         self._broker_pid_proposed = 0.0
         self._broker_pid_refreshed = 0.0
         self._stamp_lock = make_lock("BrokerServer._stamp_lock")
         self._stamp_seqs: dict[int, int] = {}
+        # --- multi-core host plane (parallel/hostplane.py) ---
+        # host_workers > 1 boots worker subprocesses owning disjoint
+        # partition-group slices of the host path: produce validation +
+        # pid/seq stamping + payload packing, and settled-mirror consume
+        # serving on the controller. Built here, started in start() —
+        # worker boots are async (~100 ms spawn of a jax-free module),
+        # so construction never blocks on them.
+        self.hostplane = None
+        self._worker_pid_names: dict[int, tuple[int, str]] = {}
+        self._worker_pids: dict[int, int] = {}
+        self._worker_pid_proposed: dict[int, float] = {}
+        if config.host_workers > 1:
+            from ripplemq_tpu.parallel.hostplane import HostPlane
+
+            self.hostplane = HostPlane(
+                config.host_workers,
+                slot_bytes=config.engine.slot_bytes,
+                payload_bytes=config.engine.payload_bytes,
+                max_batch=config.engine.max_batch,
+                ring_bytes=config.host_ring_bytes,
+                recorder=self.recorder,
+            )
+        # Pipelined replication stream gate (see _ReplStreamGate): the
+        # standby side of repl.rounds applies frames in per-stream
+        # sequence order while the sender keeps a window in flight.
+        self._repl_gate = _ReplStreamGate()
         persist_fn = None
         if data_dir is not None:
             import os
@@ -384,6 +481,8 @@ class BrokerServer:
         if dataplane is not None:
             self.dataplane = dataplane
             self.manager.attach_dataplane(dataplane)
+            if self.hostplane is not None:
+                dataplane.mirror_fn = self._mirror_publish
             if dataplane.replicate_fn is None and self._round_store is not None:
                 self._wire_replicator(dataplane)
         # No construction-time boot when this broker's (possibly
@@ -515,6 +614,8 @@ class BrokerServer:
             )
             if image is not None:
                 dp.install(image, settled_gaps=gaps, pid_table=pid_tab)
+            if self.hostplane is not None:
+                dp.mirror_fn = self._mirror_publish
             if self._round_store is not None:
                 self._wire_replicator(dp)
             self._owns_dataplane = True
@@ -610,6 +711,8 @@ class BrokerServer:
             rpc_timeout_s=min(2.0, self.config.rpc_timeout_s),
             ack_timeout_s=self.config.rpc_timeout_s,
             metrics=self.metrics,
+            sender_id=self.broker_id,
+            pipeline_depth=self.config.repl_pipeline_depth,
         )
         if self.config.replication == "striped":
             from ripplemq_tpu.stripes.plane import StripeReplicator
@@ -642,6 +745,8 @@ class BrokerServer:
 
     def start(self) -> None:
         self._started = True
+        if self.hostplane is not None:
+            self.hostplane.start()
         if self._net is not None:
             self._net.register(self.addr, self.dispatch)
         else:
@@ -681,6 +786,8 @@ class BrokerServer:
             self.dataplane.stop()
         if self._owns_store and self._round_store is not None:
             self._round_store.close()
+        if self.hostplane is not None:
+            self.hostplane.stop()
         self.client.close()
         self._raft_client.close()
 
@@ -858,6 +965,12 @@ class BrokerServer:
             ],
             "stripe_rebuilds": self._stripe_rebuilds,
         }
+        # Multi-core host plane liveness/occupancy (null when
+        # host_workers == 1 — no subprocess plane).
+        if self.hostplane is None:
+            stats["host_plane"] = None
+        else:
+            stats["host_plane"] = self.hostplane.stats()
         dp = self._local_engine()
         if dp is None:
             stats["engine"] = None
@@ -1478,23 +1591,67 @@ class BrokerServer:
         messages = req["messages"]
         if not isinstance(messages, list) or not messages:
             return {"ok": False, "error": "bad_request: empty messages"}
-        if req.get("pid") is not None:
-            pid, seq = int(req["pid"]), int(req.get("seq", -1))
-        else:
-            pid, seq = self._stamp_pid_seq(slot, len(messages))
         B = self.config.engine.max_batch
-        chunks = [messages[i : i + B] for i in range(0, len(messages), B)]
-        futs = [
-            self._engine_append(
-                slot, chunk, pid,
-                seq + i * B if pid > 0 else -1,
+        stamped = None
+        if self.hostplane is not None:
+            # Multi-core host plane: the owning worker validates, stamps
+            # (its own per-(worker, generation) pid + per-slot sequence
+            # counters — slices are disjoint) and packs the batch into
+            # max_batch-sized row blocks that ride to the engine
+            # pre-packed (DataPlane.submit_packed / engine.append_packed
+            # — the payload bytes are never re-encoded past the worker).
+            from ripplemq_tpu.parallel.hostplane import (
+                OversizeBatchError,
+                WorkerUnavailableError,
             )
-            for i, chunk in enumerate(chunks)
-        ]
+
+            try:
+                stamped = self.hostplane.submit(
+                    slot, messages, pid=req.get("pid"), seq=req.get("seq"),
+                    timeout_s=self.config.rpc_timeout_s,
+                )
+            except WorkerUnavailableError as e:
+                # Typed RETRYABLE refusal — never a silent hang: the
+                # dispatcher already detected the dead worker and is
+                # respawning it; the client's retry lands.
+                return {"ok": False, "error": f"worker_unavailable: {e}"}
+            except OversizeBatchError:
+                # The batch would not fit a ring frame: serve it on the
+                # in-process path below (no size bound there) instead
+                # of refusing — the single-process semantics are the
+                # fallback contract for every worker-plane miss.
+                stamped = None
+            except ValueError as e:
+                return {"ok": False, "error": f"bad_request: {e}"}
+        if stamped is not None:
+            pid, seq = int(stamped["pid"]), int(stamped["seq"])
+            chunk_sizes = [len(lens) for lens, _ in stamped["chunks"]]
+            futs = [
+                self._engine_append_packed(
+                    slot, lens, packed, pid,
+                    seq + i * B if pid > 0 else -1,
+                )
+                for i, (lens, packed) in enumerate(stamped["chunks"])
+            ]
+        else:
+            if req.get("pid") is not None:
+                pid, seq = int(req["pid"]), int(req.get("seq", -1))
+            else:
+                pid, seq = self._stamp_pid_seq(slot, len(messages))
+            chunks = [messages[i : i + B]
+                      for i in range(0, len(messages), B)]
+            chunk_sizes = [len(c) for c in chunks]
+            futs = [
+                self._engine_append(
+                    slot, chunk, pid,
+                    seq + i * B if pid > 0 else -1,
+                )
+                for i, chunk in enumerate(chunks)
+            ]
         base0 = None
         committed = 0
         first_err: Optional[Exception] = None
-        for chunk, fut in zip(chunks, futs):
+        for n, fut in zip(chunk_sizes, futs):
             try:
                 base = fut()
             except NotCommittedError as e:
@@ -1503,7 +1660,7 @@ class BrokerServer:
                 continue
             if base0 is None and first_err is None:
                 base0 = base
-            committed += len(chunk)
+            committed += n
         if first_err is not None:
             return {"ok": False, "error": f"not_committed: {first_err}",
                     "committed": committed}
@@ -1968,6 +2125,98 @@ class BrokerServer:
 
         return wait
 
+    def _engine_append_packed(self, slot: int, lens: list[int], packed,
+                              pid: int = 0, seq: int = -1
+                              ) -> Callable[[], int]:
+        """The pre-packed twin of _engine_append: the host-plane worker
+        already validated + packed the rows, so the local path hands the
+        block to DataPlane.submit_packed and the forwarded path ships it
+        as ONE engine.append_packed frame — the payload bytes cross the
+        leader→controller hop exactly once, in engine row format."""
+        dp = self._local_engine()
+        if dp is not None:
+            fut = dp.submit_packed(slot, packed, lens, pid=pid, seq=seq)
+            return lambda: int(fut.result(timeout=self.config.rpc_timeout_s))
+        req = {"type": "engine.append_packed", "slot": slot,
+               "lens": list(lens), "packed": packed,
+               "pid": pid, "seq": seq}
+        call_async = getattr(self.client, "call_async", None)
+        if call_async is None:  # in-proc transport: synchronous by design
+            resp = self._engine_call(req)
+            return lambda: int(resp["base_offset"])
+        rpc_fut = call_async(self._controller_addr(), req)
+
+        def wait() -> int:
+            resp = rpc_fut.result(timeout=self.config.rpc_timeout_s)
+            if not resp.get("ok"):
+                if "not_committed" in str(resp.get("error", "")):
+                    raise NotCommittedError(resp["error"])
+                raise RpcError(f"engine call failed: {resp.get('error')}")
+            return int(resp["base_offset"])
+
+        return wait
+
+    def _mirror_publish(self, slot: int, base: int, payload) -> None:
+        """DataPlane.mirror_fn: fan settled REC_APPEND rows out to the
+        owning host worker (settle thread; HostPlane.publish never
+        blocks — drops degrade to engine-read fallbacks)."""
+        hp = self.hostplane
+        if hp is not None:
+            hp.publish(slot, base, payload)
+
+    def _worker_pid_duty(self) -> None:
+        """Host-plane stamping pids: register one metadata pid per
+        (worker, generation) and install it in the worker. A RESPAWNED
+        worker restarts its sequence counters at zero, so it must stamp
+        under a FRESH pid (gen is in the name) — riding the old pid
+        would collapse fresh batches as replays in the cluster dedup
+        table. Until its pid applies, a fresh worker stamps (0, -1)
+        and produces flow unstamped (at-least-once, the pre-stamping
+        behavior). Registered pids re-register at a third of
+        pid_retention_s, the same session-refresh rule as the broker's
+        own stamping pid."""
+        hp = self.hostplane
+        if hp is None:
+            return
+        now = time.monotonic()
+        retention = self.config.pid_retention_s
+        for idx, gen in enumerate(hp.generations()):
+            known = self._worker_pid_names.get(idx)
+            if known is None or known[0] != gen:
+                self._worker_pid_names[idx] = (gen, (
+                    f"_broker/{self.broker_id}/{self._pid_nonce}"
+                    f"/w{idx}g{gen}"
+                ))
+                self._worker_pids.pop(idx, None)
+                self._worker_pid_proposed.pop(idx, None)
+            _, name = self._worker_pid_names[idx]
+            pid = self.manager.producer_id(name)
+            if pid is None:
+                if now - self._worker_pid_proposed.get(idx, 0.0) >= 1.0:
+                    self._worker_pid_proposed[idx] = now
+                    self.propose_cmd(
+                        {"op": OP_REGISTER_PRODUCER, "producer": name},
+                        retries=1,
+                    )
+                continue
+            if self._worker_pids.get(idx) != pid:
+                self._worker_pids[idx] = pid
+                # gen-fenced: a respawn since the snapshot above must
+                # drop this install (the pid belongs to the OLD
+                # generation's counters; the next duty tick registers
+                # the fresh generation's own pid).
+                hp.set_worker_pid(idx, pid, gen=gen)
+            elif (retention > 0 and
+                  now - self._worker_pid_proposed.get(idx, 0.0)
+                  >= max(1.0, retention / 3)):
+                # Session refresh: the re-registration apply bumps the
+                # replicated seen counter the pid reaper keys on.
+                self._worker_pid_proposed[idx] = now
+                self.propose_cmd(
+                    {"op": OP_REGISTER_PRODUCER, "producer": name},
+                    retries=1,
+                )
+
     def _read_barrier(self) -> None:
         """linearizable_reads: confirm this broker still commands the
         current controller epoch before serving committed data (off by
@@ -1999,6 +2248,15 @@ class BrokerServer:
         dp = self._local_engine()
         if dp is not None:
             self._read_barrier()
+            if self.hostplane is not None:
+                # Settled-mirror fast path: the owning worker serves the
+                # hot window off this process's GIL. Only a NON-EMPTY
+                # answer short-circuits — empty/behind/unavailable all
+                # fall through to the plane, which stays the authority
+                # (and owns the long-poll park below).
+                got = self.hostplane.read(slot, offset, max_msgs)
+                if got is not None and got[0]:
+                    return got
             msgs, end = dp.read(slot, offset, replica, max_msgs)
             if msgs or wait_s <= 0:
                 return msgs, end
@@ -2080,6 +2338,16 @@ class BrokerServer:
             )
             return {"ok": True,
                     "base_offset": int(fut.result(self.config.rpc_timeout_s))}
+        if t == "engine.append_packed":
+            fut = dp.submit_packed(
+                int(req["slot"]), req["packed"],
+                [int(x) for x in req["lens"]],
+                pid=int(req.get("pid", 0) or 0),
+                seq=int(req.get("seq", -1) if req.get("seq") is not None
+                        else -1),
+            )
+            return {"ok": True,
+                    "base_offset": int(fut.result(self.config.rpc_timeout_s))}
         if t == "engine.read":
             limit = req.get("max_msgs")
             msgs, end = self._engine_read(
@@ -2134,6 +2402,19 @@ class BrokerServer:
         store = self._round_store
         if store is None:
             return {"ok": False, "error": "no_store"}
+        sseq = req.get("sseq")
+        gate_key = None
+        if sseq is not None:
+            # Pipelined stream: apply strictly in per-stream sequence
+            # order (see _ReplStreamGate — duplicates re-apply, gaps
+            # refuse with the expected counter so the sender rewinds).
+            sseq = int(sseq)
+            gate_key = (int(req.get("sender", -1)), epoch)
+            if not self._repl_gate.enter(gate_key, sseq):
+                return {"ok": False,
+                        "error": "repl_seq_gap: pipelined predecessor "
+                                 "frame missing; rewind onto expected",
+                        "expected": self._repl_gate.expected(gate_key)}
         recs = [(int(t), int(s), int(b), p) for t, s, b, p in req["records"]]
         append_many = getattr(store, "append_many", None)
         if append_many is not None:
@@ -2141,6 +2422,8 @@ class BrokerServer:
         else:
             for rec in recs:
                 store.append(*rec)
+        if gate_key is not None:
+            self._repl_gate.applied(gate_key, sseq)
         if self.config.durability == "strict":
             # durability=strict: this ack gates a settled round's
             # producer ack, so the records must be ON DISK before it
@@ -2298,6 +2581,7 @@ class BrokerServer:
             try:
                 self._metadata_leader_duty()
                 self._producer_pid_duty()
+                self._worker_pid_duty()
                 self._pid_reap_duty()
                 self._group_duty()
                 self._abdicate_duty()

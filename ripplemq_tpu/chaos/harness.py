@@ -243,6 +243,7 @@ def run_chaos(
     groups: int = 0,
     replication_mode: str = "full",
     lock_witness: bool = False,
+    host_workers: int = 1,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -316,6 +317,10 @@ def run_chaos(
             # the rebalance it forces) lands INSIDE a chaos phase.
             group_session_timeout_s=0.8,
             replication=replication_mode,
+            # host_workers > 1 drives the multi-core host plane on real
+            # broker subprocesses: every produce stamps/packs through a
+            # worker, controller consumes serve off the settled mirror.
+            host_workers=host_workers,
         )
         cluster = ProcCluster(config=config, data_dir=data_dir)
     else:
@@ -333,12 +338,14 @@ def run_chaos(
             linearizable_reads=True,
             group_session_timeout_s=0.8,  # see the proc branch above
             replication=replication_mode,
+            host_workers=host_workers,  # see the proc branch above
         )
         cluster = InProcCluster(config, data_dir=data_dir)
     history = History()
     verdict: dict = {"seed": seed, "phases": phases,
                      "ops_per_phase": ops_per_phase, "backend": backend,
-                     "replication": replication_mode}
+                     "replication": replication_mode,
+                     "host_workers": host_workers}
     try:
         cluster.start()
         cluster.wait_for_leaders()

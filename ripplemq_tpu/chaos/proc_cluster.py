@@ -138,6 +138,9 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "chain_depth": config.chain_depth,
         "pipeline_depth": config.pipeline_depth,
         "rpc_workers": config.rpc_workers,
+        "host_workers": config.host_workers,
+        "host_ring_bytes": config.host_ring_bytes,
+        "repl_pipeline_depth": config.repl_pipeline_depth,
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
         "lock_witness": config.lock_witness,

@@ -155,6 +155,27 @@ class ClusterConfig:
     # zero overhead; debug/chaos harnesses turn it on (run_chaos
     # lock_witness=True, profiles/chaos_soak.py --witness).
     lock_witness: bool = False
+    # Multi-core host plane (parallel/hostplane.py): worker subprocesses
+    # per broker, each owning the disjoint partition-group slice
+    # `slot % host_workers` of the data-plane HOST path (submit
+    # validation, pid/seq stamping, payload packing, settled-mirror
+    # consume serving). 1 = no subprocess plane (everything in-process,
+    # the pre-PR-12 shape). The device program and replication plane
+    # are unaffected: committed prefixes are byte-identical across
+    # host_workers values.
+    host_workers: int = 1
+    # Shared-memory ring capacity per direction per worker (the
+    # dispatcher<->worker frame rings; parallel/shmring.py). Frames are
+    # capped at half the ring.
+    host_ring_bytes: int = 4 << 20
+    # Standby replication stream pipelining: how many epoch-stamped,
+    # per-stream-sequence-numbered repl.rounds frames one sender keeps
+    # in flight before waiting on the oldest ack (broker/replication.py
+    # _Sender). 1 = the PR 3 synchronous call-per-group behavior; the
+    # standby applies frames strictly in sequence order either way
+    # (BrokerServer repl-stream gate), so a slow ack no longer caps the
+    # stream at one group per round trip.
+    repl_pipeline_depth: int = 4
     # RPC worker pool per broker. A produce/engine.append handler BLOCKS
     # its worker until the round commits, so this caps a broker's
     # in-flight appends — size it to the offered concurrency (threads
@@ -176,6 +197,36 @@ class ClusterConfig:
             )
         if self.pid_retention_s < 0:
             raise ValueError("pid_retention_s must be >= 0 (0 disables)")
+        if not 1 <= self.host_workers <= 64:
+            raise ValueError(
+                f"host_workers must be in [1, 64], got {self.host_workers}"
+            )
+        if self.host_ring_bytes < (1 << 20):
+            raise ValueError(
+                f"host_ring_bytes={self.host_ring_bytes} below the 1 MiB "
+                f"floor: frames cap at half the ring, and a full "
+                f"max_batch mirror frame (max_batch x slot_bytes rows) "
+                f"must fit or every settled-mirror publish drops"
+            )
+        if self.host_workers > 1:
+            # The invariant the floor message states, checked against
+            # the ACTUAL engine shape: a full-round mirror frame
+            # (max_batch x slot_bytes rows + codec overhead) must fit
+            # the half-ring frame cap, or the worker plane silently
+            # degrades to ring hops that never serve anything.
+            round_bytes = self.engine.max_batch * self.engine.slot_bytes
+            if round_bytes + 4096 > self.host_ring_bytes // 2:
+                raise ValueError(
+                    f"host_ring_bytes={self.host_ring_bytes} cannot carry "
+                    f"one full round's mirror frame (max_batch "
+                    f"{self.engine.max_batch} x slot_bytes "
+                    f"{self.engine.slot_bytes} = {round_bytes} bytes vs "
+                    f"the {self.host_ring_bytes // 2}-byte frame cap) — "
+                    f"raise host_ring_bytes to at least "
+                    f"{2 * (round_bytes + 4096)}"
+                )
+        if self.repl_pipeline_depth < 1:
+            raise ValueError("repl_pipeline_depth must be >= 1")
         # Shards (~segment_bytes / 3 each) travel in single wire frames
         # (shard.put / shard.get), which the codec hard-caps at 64 MB —
         # an oversize segment would make shard distribution fail forever.
@@ -290,6 +341,12 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["standby_count"] = int(raw["standby_count"])
     if "rpc_workers" in raw:
         extra["rpc_workers"] = int(raw["rpc_workers"])
+    if "host_workers" in raw:
+        extra["host_workers"] = int(raw["host_workers"])
+    if "host_ring_bytes" in raw:
+        extra["host_ring_bytes"] = int(raw["host_ring_bytes"])
+    if "repl_pipeline_depth" in raw:
+        extra["repl_pipeline_depth"] = int(raw["repl_pipeline_depth"])
     if "linearizable_reads" in raw:
         extra["linearizable_reads"] = bool(raw["linearizable_reads"])
     if "obs" in raw:

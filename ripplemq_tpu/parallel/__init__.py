@@ -1,7 +1,10 @@
-"""Mesh construction and SPMD execution of the core replication steps."""
+"""Mesh construction and SPMD execution of the core replication steps.
 
-from ripplemq_tpu.parallel.mesh import make_mesh, pick_axes
-from ripplemq_tpu.parallel.engine import LocalEngineFns, SpmdEngineFns, make_local_fns, make_spmd_fns
+Re-exports are lazy (PEP 562): `parallel.shmring` / `parallel.hostplane`
+are the jax-free modules the spawned host-plane workers import, and an
+eager mesh/engine import here would charge every worker boot the full
+jax initialization.
+"""
 
 __all__ = [
     "make_mesh",
@@ -11,3 +14,17 @@ __all__ = [
     "make_local_fns",
     "make_spmd_fns",
 ]
+
+_MESH = ("make_mesh", "pick_axes")
+
+
+def __getattr__(name):
+    if name in _MESH:
+        from ripplemq_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    if name in __all__:
+        from ripplemq_tpu.parallel import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -306,6 +306,8 @@ class StripeReplicator:
         stripe_map_fn: Optional[Callable[[], tuple]] = None,
         live_fn: Optional[Callable[[], list]] = None,
         encode_kw: Optional[dict] = None,
+        sender_id: int = -1,
+        pipeline_depth: int = 1,
     ) -> None:
         self.client = client
         self.addr_of = addr_of
@@ -314,6 +316,13 @@ class StripeReplicator:
         self.active = active_fn
         self.rpc_timeout_s = rpc_timeout_s
         self.ack_timeout_s = ack_timeout_s
+        # Constructor parity with RoundReplicator (the broker passes one
+        # kwargs dict to either plane). The stripe stream settles at
+        # any-k acks, so one slow member never heads-of-line the round
+        # the way the full-copy stream did — per-stream pipelining is
+        # carried for parity and future use, not consulted yet.
+        self.sender_id = int(sender_id)
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.stripe_map_fn = stripe_map_fn or (
             lambda: stripe_assignment(members_fn())
         )
