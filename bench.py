@@ -1522,6 +1522,92 @@ def _run_group_consume(n_groups: int = 3, members: int = 2,
         }
 
 
+def _run_slo_convergence(target_ms: float = 25.0, light_s: float = 1.5,
+                         heavy_s: float = 10.0) -> dict:
+    """SLO autopilot time-to-SLO after a STEP-LOAD change (ISSUE 13):
+    a 1-broker in-proc cluster runs with the control loop engaged, a
+    light warm phase establishes the steady operating point, then the
+    offered load steps to a saturating pipelined stream. The phase
+    reads the controller's own tick history (admin.stats `slo`) and
+    reports the wall-clock from the step to the first post-step window
+    back inside the p99 target — plus whether the step ever breached
+    it at all (on a fast host the static point may simply absorb the
+    step; the number is a measurement, not an assertion — the
+    contract lives in tests/test_slo_chaos.py)."""
+    import time as _time
+
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.client import ProducerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    config = make_cluster_config(
+        1, topics=(Topic("slobench", 1, 1),),
+        standby_count=0,
+        slo_p99_ack_ms=target_ms, slo_tick_s=0.1,
+        slo_chain_depth_max=4,
+    )
+    with InProcCluster(config) as cluster:
+        cluster.wait_for_leaders()
+        bootstrap = [b.address for b in config.brokers]
+        producer = ProducerClient(
+            bootstrap, transport=cluster.client("slobench-p"),
+            rpc_timeout_s=10.0,
+        )
+        admin = cluster.client("slobench-admin")
+        addr = config.brokers[0].address
+        payload = b"s" * 16  # inside the small-engine payload_bytes
+        try:
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < light_s:
+                producer.produce("slobench", payload, partition=0)
+                _time.sleep(0.005)
+            t_step = _time.time()
+            waiters = []
+            deadline = _time.monotonic() + heavy_s
+            while _time.monotonic() < deadline:
+                # Saturating pipelined step: a window of async batches
+                # deep enough to queue the settle pipeline. Refusals
+                # are EXPECTED here — the step exists to provoke the
+                # breach, and once the shed machine engages this
+                # quota-less producer draws `overloaded:` refusals the
+                # async waiter surfaces as ProduceError; the phase
+                # keeps offering load (that IS the measured scenario),
+                # it must not die on the refusal it engineered.
+                try:
+                    while len(waiters) < 64:
+                        waiters.append(producer.produce_batch_async(
+                            "slobench", [payload] * 16, partition=0))
+                    waiters.pop(0)()
+                except Exception:
+                    _time.sleep(0.005)
+            for w in waiters:
+                try:
+                    w()
+                except Exception:
+                    pass
+            st = admin.call(addr, {"type": "admin.stats"}, timeout=10.0)
+        finally:
+            producer.close()
+        slo = st["slo"]
+        hist = [row for row in slo["tick_history"] if row[0] >= t_step]
+        breach_t = next((row[0] for row in hist if row[2] == 0.0), None)
+        time_to_slo = None
+        if breach_t is not None:
+            rec_t = next((row[0] for row in hist
+                          if row[0] > breach_t and row[2] == 1.0), None)
+            if rec_t is not None:
+                time_to_slo = round(rec_t - t_step, 3)
+        return {
+            "target_p99_ms": target_ms,
+            "breached_after_step": breach_t is not None,
+            "time_to_slo_s": time_to_slo,
+            "adjustments": slo["adjustments"],
+            "final_knobs": slo["knobs"],
+            "final_p99_ms": slo["p99_ms"],
+            "meeting_slo": slo["meeting_slo"],
+        }
+
+
 def _run_stripe_encode(mb: int = 4, reps: int = 3) -> float:
     """stripe_encode_mb_per_sec: GF(2⁸) RS(3,2) group-encode throughput
     at the sender's group-commit blob shape (one gf_matmul per blob —
@@ -1796,6 +1882,8 @@ def main() -> None:
     # ISSUE 7: multi-group drain through the consumer-group coordinator
     # (count-exact per group, shared offsets, generation fencing live).
     group_consume = _run_group_consume()
+    # ISSUE 13: SLO autopilot time-to-SLO after a step-load change.
+    slo_convergence = _run_slo_convergence()
     e2e = _run_e2e()
     # ISSUE 12: the multi-core host plane's same-host worker sweep
     # (workers 1/2/4, subprocess clients everywhere, count-exact).
@@ -1829,6 +1917,7 @@ def main() -> None:
                 "stripe_encode_mb_per_sec": stripe_encode,
                 "readback": "verified",
                 "host_plane_scaling": host_plane_scaling,
+                "slo_convergence": slo_convergence,
                 **group_consume,
                 **e2e,
             }

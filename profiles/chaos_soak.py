@@ -87,6 +87,14 @@ def main() -> int:
     ap.add_argument("--postmortems", action="store_true",
                     help="attach per-broker admin.postmortem bundles even "
                          "on clean runs; violating runs always carry them")
+    ap.add_argument("--slo", action="store_true",
+                    help="run the cluster with the SLO autopilot engaged "
+                         "(slo/controller.py): the verdict gains an `slo` "
+                         "section and the degradation contract — shed "
+                         "engages under a sustained fault, safety holds "
+                         "while shedding, recovery to SLO within "
+                         "slo_recover_s of heal — is checked as "
+                         "first-class violations")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON file holding a recorded trace (or a full "
                          "verdict) to re-apply instead of generating "
@@ -142,6 +150,7 @@ def main() -> int:
             include_postmortems=args.postmortems,
             lock_witness=args.witness,
             host_workers=args.host_workers,
+            slo=args.slo,
             # Process boots (JAX import + XLA compiles per broker) put
             # convergence probes on a different clock than in-proc runs.
             converge_timeout_s=120.0 if args.backend == "proc" else 30.0,

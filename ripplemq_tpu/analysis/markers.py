@@ -67,6 +67,8 @@ FAST_MODULES = {
     "test_readme_bench",
     "test_settle_pipeline",
     "test_settled_gap",
+    "test_slo",                 # fake-clock control-loop units
+    "test_slo_chaos",           # ~20 s: one 3-broker slo chaos smoke
     "test_term_skew",
     "test_repl_pipeline",       # ~6 s: stub-client sender window units
     "test_retention",

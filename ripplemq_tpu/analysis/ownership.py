@@ -42,6 +42,10 @@ SCAN_ROOTS = (
     "ripplemq_tpu/stripes",
     "ripplemq_tpu/parallel",
     "ripplemq_tpu/wire",
+    # The SLO autopilot mutates broker-host-path state (knobs, shed
+    # gate, tick rings) from its own control thread — in scope from
+    # day one.
+    "ripplemq_tpu/slo",
 )
 
 CALLER = "(caller)"

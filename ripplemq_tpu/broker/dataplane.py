@@ -524,6 +524,14 @@ class DataPlane:
         self.settle_depth_sum = 0
         self.settle_samples = 0
         self.settle_backpressure = 0
+        # Live settle-window occupancy (rounds between window entry and
+        # release) and the SLO autopilot's soft-window bookkeeping: the
+        # controller shrinks the effective window by holding
+        # `_settle_held` semaphore permits (set_knobs), so the window
+        # narrows without rebuilding the semaphore mid-flight. Both
+        # guarded by self._lock.
+        self._settle_inflight = 0
+        self._settle_held = 0
         # Guarded by self._lock (read by _drain, cleared by the resolver).
         self._busy_a: set[int] = set()   # partition slots with appends in flight
         self._busy_o: set[int] = set()   # ... with offset commits in flight
@@ -757,6 +765,57 @@ class DataPlane:
         with self._lock:
             lost = self.alive.sum(axis=1) < self.quorum
         return [int(s) for s in np.nonzero(lost)[0]]
+
+    # --------------------------------------------------- runtime knobs (SLO)
+
+    def knob_state(self) -> dict:
+        """The SLO autopilot's view of the adjustable operating point,
+        under the plane's lock: the live coalesce/chain values, the
+        EFFECTIVE settle window (configured minus soft-held permits),
+        the configured cap, and the window's live occupancy."""
+        with self._lock:
+            return {
+                "read_coalesce_s": float(self.read_coalesce_s),
+                "chain_depth": int(self.chain_depth),
+                "settle_window": int(self.settle_window - self._settle_held),
+                "settle_window_cap": int(self.settle_window),
+                "settle_inflight": int(self._settle_inflight),
+            }
+
+    def set_knobs(self, read_coalesce_s: Optional[float] = None,
+                  chain_depth: Optional[int] = None,
+                  settle_window: Optional[int] = None) -> dict:
+        """Apply one SLO-controller decision (slo/controller.py). All
+        writes ride self._lock: _drain reads chain_depth under the same
+        lock, so one dispatch never sees a torn value, and the ownership
+        lint's common-mutex rule holds for the controller thread plus
+        any direct caller (tests, profiles).
+
+        `settle_window` is a SOFT bound in [slo_settle_window_min,
+        configured window]: shrinking acquires spare semaphore permits
+        non-blocking (occupied slots converge on later ticks as rounds
+        release — never blocks the control loop against a full window),
+        growing releases held ones. `chain_depth` changes take effect at
+        the next dispatch; a depth this plane has not run yet compiles
+        its chain program lazily on first use (the controller moves on a
+        power-of-two ladder to bound that to log2(max) programs)."""
+        with self._lock:
+            if read_coalesce_s is not None:
+                self.read_coalesce_s = max(0.0, float(read_coalesce_s))
+            if chain_depth is not None:
+                self.chain_depth = max(1, int(chain_depth))
+            if settle_window is not None:
+                want = min(self.settle_window,
+                           max(1, int(settle_window)))
+                target_held = self.settle_window - want
+                while self._settle_held > target_held:
+                    self._settle_sem.release()
+                    self._settle_held -= 1
+                while self._settle_held < target_held:
+                    if not self._settle_sem.acquire(blocking=False):
+                        break  # window occupied: converge next tick
+                    self._settle_held += 1
+        return self.knob_state()
 
     @property
     def broken_reason(self) -> Optional[str]:
@@ -2167,6 +2226,10 @@ class DataPlane:
         with self._lock:
             self.settle_depth_sum += self._settle_q.qsize()
             self.settle_samples += 1
+            # Live occupancy (knob_state): held from window entry until
+            # _release_one's release — the SLO shed machine's
+            # settle-occupancy signal.
+            self._settle_inflight += 1
         self._settle_q.put((ctx, committed, records, ticket, exc))
 
     def _settle_loop(self) -> None:
@@ -2306,6 +2369,8 @@ class DataPlane:
                                  fenced=self._settle_fenced)
             self._fail_committed(ctx, committed, e)
         finally:
+            with self._lock:
+                self._settle_inflight -= 1
             self._settle_sem.release()
 
     def _mirror_records(self, records) -> None:

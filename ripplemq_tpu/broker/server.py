@@ -251,6 +251,11 @@ class BrokerServer:
 
         self.metrics = Metrics(enabled=config.obs)
         self.recorder = FlightRecorder()
+        # Produce-ack latency as the CLIENT of this broker experiences
+        # it (admission → all pipelined rounds settled), observed in
+        # _handle_produce. This is the SLO controller's plant output:
+        # the p99 it steers toward slo_p99_ack_ms.
+        self._m_ack_us = self.metrics.histogram("produce.ack_us")
         # Codec stats are process-global: set them symmetrically (last
         # constructed broker wins) rather than latching off forever —
         # a one-way disable would freeze the A/B's obs=True arm when an
@@ -310,6 +315,11 @@ class BrokerServer:
         # How many striped-promotion rebuilds this process ran
         # (admin.stats `stripe_rebuilds`; stripes/recovery.py).
         self._stripe_rebuilds = 0
+        # SLO shed machine's empty-standby-set latch (see _slo_degraded:
+        # the signal arms only after a standby ever joined — genesis
+        # settles member-less by design). Written from the slo control
+        # thread only.
+        self._slo_had_standbys = False
         # Since the last quarantine, has this broker been observed OUT of
         # the replicated standby set? A broker that died IN the set boots
         # with stale membership still naming it — which proves nothing
@@ -517,6 +527,20 @@ class BrokerServer:
         self._engine_busy_at = 0.0  # last duty tick the plane looked busy
         # Read-index barrier (linearizable_reads; see _BarrierGate).
         self._barrier_gate = _BarrierGate(self._fire_read_barrier)
+        # --- SLO autopilot (ripplemq_tpu/slo/) ---
+        # Always constructed (admission quotas work without the loop;
+        # admin.stats serves the `slo` block either way); the control
+        # thread only starts when slo_p99_ack_ms > 0. dataplane_fn
+        # resolves lazily to the CURRENT controller's plane — knob
+        # adjustment and engine-side shed signals follow controllership
+        # the same way engine RPCs do.
+        from ripplemq_tpu.slo.controller import SloController
+
+        self.slo = SloController(
+            config, metrics=self.metrics, recorder=self.recorder,
+            dataplane_fn=self._local_engine,
+            degraded_fn=self._slo_degraded,
+        )
         # Fully constructed: teardown may now run (see the top of __init__).
         self._stopped = False
 
@@ -740,6 +764,34 @@ class BrokerServer:
             return dp
         return None
 
+    def _slo_degraded(self) -> bool:
+        """The SLO shed machine's quorum-degradation signal. Like every
+        shed signal it is ENGINE-SIDE (non-None only on the current
+        controller — shedding exists to drain a queueing pipe, and the
+        pipe lives here; see slo/controller.py for why a frontend-local
+        p99 signal was deliberately removed): an engine partition lost
+        its replica quorum, OR controller failover is armed
+        (standby_count > 0) and the replicated standby set is EMPTY —
+        in that state the settle path refuses every round (the PR 2
+        empty-set fence), so refusing cheaply at admission is strictly
+        kinder than queueing produces into certain refusal."""
+        dp = self._local_engine()
+        if dp is None:
+            return False
+        if dp.degraded_slots():
+            return True
+        if self.config.standby_count <= 0 or self._round_store is None:
+            return False
+        if self.manager.current_standbys():
+            # Arm the empty-set signal only once a standby EVER joined
+            # (the replicator's _had_members rule): genesis settles
+            # member-less by design, and shedding a freshly-booted
+            # cluster for not yet having standbys would be a
+            # self-inflicted outage.
+            self._slo_had_standbys = True
+            return False
+        return self._slo_had_standbys
+
     def _addr_of(self, broker_id: int) -> str:
         return self.config.broker(broker_id).address
 
@@ -755,6 +807,7 @@ class BrokerServer:
             self.dataplane.start()
         self.runner.start()
         self._duty_thread.start()
+        self.slo.start()
 
     @property
     def stopped(self) -> bool:
@@ -774,6 +827,7 @@ class BrokerServer:
             return
         self._stopped = True
         self._stop.set()
+        self.slo.stop()
         self._duty_thread.join(timeout=2)
         self.runner.stop()
         if self._net is not None:
@@ -971,6 +1025,11 @@ class BrokerServer:
             stats["host_plane"] = None
         else:
             stats["host_plane"] = self.hostplane.stats()
+        # SLO autopilot: mode, current knob values, shed/refusal counts,
+        # and the tick/transition history chaos verdicts replay
+        # (`enabled: false` shape when the loop is off — the admission
+        # counters still live there, quotas work without the loop).
+        stats["slo"] = self.slo.stats()
         dp = self._local_engine()
         if dp is None:
             stats["engine"] = None
@@ -1560,6 +1619,27 @@ class BrokerServer:
         return slot, None
 
     def _handle_produce(self, req: dict) -> dict:
+        """Admission + ack-latency instrumentation around the produce
+        path. Admission runs FIRST — before partition resolution,
+        validation, pid stamping, payload packing, or a worker-ring hop
+        — so a shed/quota refusal under overload costs one dict lookup
+        (slo/admission.py; typed retryable `overloaded:`, so clients
+        jitter-backoff instead of hammering the refusal). Admitted
+        requests observe their full wall time (success AND failure —
+        timeouts are exactly the overload signal) into `produce.ack_us`,
+        the p99 the SLO controller steers against."""
+        messages = req.get("messages")
+        n = len(messages) if isinstance(messages, list) else 1
+        refusal = self.slo.admit(req.get("producer"), n)
+        if refusal is not None:
+            return {"ok": False, "error": f"overloaded: {refusal}"}
+        t0 = self.metrics.clock()
+        try:
+            return self._produce_admitted(req)
+        finally:
+            self._m_ack_us.observe(self.metrics.clock() - t0)
+
+    def _produce_admitted(self, req: dict) -> dict:
         """Produce semantics: at-least-once by default, EXACTLY-ONCE for
         idempotent producers. A batch larger than max_batch is split into
         pipelined rounds, and some rounds can fail while others commit (a

@@ -225,6 +225,133 @@ def _collect_broker_obs(cluster) -> tuple[dict[str, dict], list[dict]]:
     return postmortems, events
 
 
+def _collect_slo_stats(cluster) -> dict[str, dict]:
+    """One admin.stats `slo` block per reachable broker, over the real
+    transport (both backends) — the shed/recovery timeline lives in
+    each controller's tick ring, which survives the post-heal drain
+    (the flight-recorder ring can scroll under traffic; the tick ring
+    cannot)."""
+    out: dict[str, dict] = {}
+    client = cluster.client("slo-collect")
+    for bid in cluster.brokers:
+        try:
+            st = client.call(cluster.broker_addr(bid),
+                             {"type": "admin.stats"}, timeout=10.0)
+        except Exception:
+            continue
+        if st.get("ok") and isinstance(st.get("slo"), dict):
+            out[str(bid)] = st["slo"]
+    return out
+
+
+def check_slo(slo_stats: dict[str, dict], timeline: list[dict],
+              shed_bound_s: float, recover_s: float,
+              expect_shed: bool = False) -> tuple[dict, list[str]]:
+    """The degradation contract, from the brokers' own control
+    timelines (SloController tick rings) against the nemesis's
+    wall-clocked fault/heal marks. Returns (the verdict `slo` section,
+    its violations — first-class, alongside exactly-once):
+
+    1. with `expect_shed` (the caller KNOWS the schedule injects a
+       sustained overload — the tier-1 smoke's crash-both-standbys
+       shape): some broker's shed machine ENGAGED within
+       `shed_bound_s` of the first injected fault. Without it the
+       section still reports engagement, but a mild seeded schedule
+       the plane absorbs WITHOUT distress is the system working, not
+       a violation — randomized soaks must stay green on gentle
+       seeds;
+    2. after the LAST heal, the system RETURNED TO SLO within
+       `recover_s`: at least one broker observed a post-heal tick
+       meeting the p99 target with shedding off, and every broker's
+       final mode is back off shed (both unconditional — every run
+       must end healthy).
+
+    (Safety-while-shedding is the ordinary checker, unconditional —
+    shedding changes admission, never settled state.)"""
+    fault_ts = [e["t"] for e in timeline
+                if e.get("src") == "nemesis"
+                and e.get("type") not in ("heal", "restart",
+                                          "restart_stripe")]
+    heal_ts = [e["t"] for e in timeline
+               if e.get("src") == "nemesis" and e.get("type") == "heal"]
+    first_fault = min(fault_ts, default=None)
+    last_heal = max(heal_ts, default=None)
+
+    shed_at: Optional[float] = None      # first shed tick >= first fault
+    recovered_at: Optional[float] = None  # first ok+unshed tick >= heal
+    final_modes: dict[str, str] = {}
+    refused = 0
+    for bid, s in slo_stats.items():
+        final_modes[bid] = s.get("mode", "?")
+        adm = s.get("admission") or {}
+        refused += int(adm.get("shed_refusals", 0))
+        refused += int(adm.get("quota_refusals", 0))
+        for t, p99, ok, shed in s.get("tick_history", ()):
+            if (shed == 1.0 and first_fault is not None
+                    and t >= first_fault
+                    and (shed_at is None or t < shed_at)):
+                shed_at = t
+            if (ok == 1.0 and shed == 0.0 and last_heal is not None
+                    and t >= last_heal
+                    and (recovered_at is None or t < recovered_at)):
+                recovered_at = t
+    engaged_s = (None if shed_at is None or first_fault is None
+                 else round(shed_at - first_fault, 3))
+    recover_in = (None if recovered_at is None or last_heal is None
+                  else round(recovered_at - last_heal, 3))
+    still_shedding = sorted(b for b, m in final_modes.items()
+                            if m == "shed")
+    violations: list[str] = []
+    if not slo_stats:
+        violations.append("slo: no broker served an slo stats block")
+    else:
+        if expect_shed and shed_at is None:
+            violations.append(
+                "slo: shed mode never engaged under the injected faults "
+                "(the degradation contract's reaction half; this "
+                "schedule is declared to sustain an overload)"
+            )
+        elif expect_shed and engaged_s is not None \
+                and engaged_s > shed_bound_s:
+            violations.append(
+                f"slo: shedding engaged {engaged_s}s after the first "
+                f"fault (> {shed_bound_s}s bound)"
+            )
+        if recover_in is None:
+            violations.append(
+                "slo: no post-heal in-SLO window observed (the system "
+                "never returned to its p99 target with shedding off)"
+            )
+        elif recover_in > recover_s:
+            violations.append(
+                f"slo: returned to SLO {recover_in}s after the last "
+                f"heal (> {recover_s}s slo_recover_s bound)"
+            )
+        if still_shedding:
+            violations.append(
+                f"slo: brokers {still_shedding} still shedding at the "
+                f"end of the run"
+            )
+    section = {
+        "target_p99_ms": next(
+            (s.get("target_p99_ms") for s in slo_stats.values()), None),
+        "shed_engaged": shed_at is not None,
+        "shed_engaged_after_s": engaged_s,
+        "shed_bound_s": shed_bound_s,
+        "recovered_within_s": recover_in,
+        "recover_bound_s": recover_s,
+        "refused": refused,
+        "final_modes": final_modes,
+        "per_broker": {
+            b: {k: s.get(k) for k in
+                ("mode", "shed_count", "adjustments", "ticks", "p99_ms",
+                 "meeting_slo", "knobs")}
+            for b, s in slo_stats.items()
+        },
+    }
+    return section, violations
+
+
 def run_chaos(
     seed: int,
     n_brokers: int = 3,
@@ -244,6 +371,11 @@ def run_chaos(
     replication_mode: str = "full",
     lock_witness: bool = False,
     host_workers: int = 1,
+    slo: bool = False,
+    slo_target_p99_ms: float = 100.0,
+    slo_recover_s: float = 45.0,
+    slo_shed_bound_s: float = 15.0,
+    slo_expect_shed: bool = False,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -286,7 +418,27 @@ def run_chaos(
     has not scheduled yet), and a witnessed edge outside the static
     lock graph's transitive closure (`analysis/lock_graph.py` — an
     ordering the AST missed via indirection must become a derived or
-    declared static edge, or the gap grows silently)."""
+    declared static edge, or the gap grows silently).
+
+    `slo=True` runs the cluster with the SLO autopilot engaged
+    (slo_p99_ack_ms = `slo_target_p99_ms`, 0.2 s ticks, chain rails
+    clamped to the configured depth so the loop never compiles new
+    chain programs mid-fault) on EITHER backend, and the verdict gains
+    an `slo` section whose invariants are first-class violations, the
+    degradation contract alongside exactly-once: (1) with
+    `slo_expect_shed=True` (the caller declares the schedule sustains
+    an overload), shedding ENGAGES within `slo_shed_bound_s` of the
+    first injected fault (measured from the brokers' own tick history
+    — the shed machine reacted; a gentle seeded schedule the plane
+    absorbs without distress is the system working, so random-pool
+    soaks leave this off and engagement stays informational);
+    (2) acked traffic stays safe while shedding (the ordinary checker,
+    unconditional — shedding changes admission, never settled state);
+    (3) the system RETURNS TO SLO within `slo_recover_s` of the last
+    heal (a post-heal tick meeting the p99 target with shedding off,
+    every broker's final mode back to steady). Wall-clock bounds are
+    measured honestly; contended tier-1 hosts gate them the same way
+    they gate the convergence probe (tests/helpers.py)."""
     t0 = time.time()
     topic = "chaos"
     tmp = None
@@ -302,6 +454,18 @@ def run_chaos(
         # no-acked-loss invariant CHECKABLE under controller crashes
         # even before a standby forms.
         tmp = data_dir = tempfile.mkdtemp(prefix=f"chaos-{seed}-")
+    # SLO autopilot config (both backends): tight ticks so the shed
+    # machine reacts inside a chaos phase; chain rails clamped to the
+    # configured depth so the loop never compiles a fresh chain program
+    # mid-fault (the loop steers coalesce + the settle window instead).
+    slo_kw = {}
+    if slo:
+        slo_kw = dict(
+            slo_p99_ack_ms=float(slo_target_p99_ms),
+            slo_tick_s=0.2,
+            slo_recover_s=float(slo_recover_s),
+            slo_chain_depth_max=4,
+        )
     if backend == "proc":
         from ripplemq_tpu.chaos.proc_cluster import (
             ProcCluster,
@@ -321,6 +485,7 @@ def run_chaos(
             # broker subprocesses: every produce stamps/packs through a
             # worker, controller consumes serve off the settled mirror.
             host_workers=host_workers,
+            **slo_kw,
         )
         cluster = ProcCluster(config=config, data_dir=data_dir)
     else:
@@ -328,6 +493,7 @@ def run_chaos(
             n_brokers=n_brokers,
             topics=(Topic(topic, partitions, replication),),
             rpc_timeout_s=3.0,
+            **slo_kw,
             # The checker asserts offset monotonicity and committed-
             # prefix consistency ACROSS controller moves; with
             # linearizable_reads off, a deposed-but-partitioned
@@ -471,6 +637,20 @@ def run_chaos(
                     f"{wreport['uncovered_edges']} — derive or declare "
                     f"them (analysis/lock_graph.py DECLARED_EDGES)"
                 )
+        if slo:
+            # The degradation contract (tentpole, ISSUE 13): shed
+            # engages under the fault, safety held while shedding (the
+            # checker above ran unconditionally), recovery to SLO
+            # within slo_recover_s of heal. Its misses are first-class
+            # violations — a violating run attaches postmortems below
+            # exactly like an acked-loss one.
+            slo_section, slo_violations = check_slo(
+                _collect_slo_stats(cluster), nemesis.timeline,
+                shed_bound_s=slo_shed_bound_s, recover_s=slo_recover_s,
+                expect_shed=slo_expect_shed,
+            )
+            verdict["slo"] = slo_section
+            violations += slo_violations
         ops = history.ops()
         # Telemetry collection — while the cluster is still up. Every
         # VIOLATING verdict carries the full diagnosis (per-broker

@@ -144,6 +144,19 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
         "lock_witness": config.lock_witness,
+        # SLO autopilot (the control loop must run the same operating
+        # point on the subprocess backend as in-proc — the exact drop
+        # class the config_plumbing lint exists to prevent).
+        "slo_p99_ack_ms": config.slo_p99_ack_ms,
+        "slo_tick_s": config.slo_tick_s,
+        "slo_recover_s": config.slo_recover_s,
+        "slo_read_coalesce_min_s": config.slo_read_coalesce_min_s,
+        "slo_read_coalesce_max_s": config.slo_read_coalesce_max_s,
+        "slo_chain_depth_min": config.slo_chain_depth_min,
+        "slo_chain_depth_max": config.slo_chain_depth_max,
+        "slo_settle_window_min": config.slo_settle_window_min,
+        "slo_shed_occupancy": config.slo_shed_occupancy,
+        "slo_quotas": {t: r for t, r in config.slo_quotas},
     }
 
 

@@ -138,8 +138,14 @@ class ProducerClient:
                 pid = self._ensure_pid(addr, run)
                 if pid is not None:
                     seq = self._reserve_seq(topic, pin, n)
+            # The producer NAME rides every request (pid or not): its
+            # prefix before the first "/" is the tenant key the broker's
+            # SLO admission controller meters (slo/admission.py) — an
+            # `overloaded:` refusal is retryable, and this loop's
+            # jittered exponential backoff IS the client half of the
+            # shed contract (retrying flat-out would defeat it).
             req = {"type": "produce", "topic": topic, "partition": pin,
-                   "messages": list(messages)}
+                   "messages": list(messages), "producer": self._pid_name}
             if pid is not None:
                 req["pid"], req["seq"] = pid, seq
             try:
@@ -235,7 +241,7 @@ class ProducerClient:
         if addr is None:
             raise ProduceError(f"no leader known for {topic}[{pid}]")
         req = {"type": "produce", "topic": topic, "partition": pid,
-               "messages": list(messages)}
+               "messages": list(messages), "producer": self._pid_name}
         if self._idempotence:
             if self._pid is None:
                 # One synchronous registration RPC on the first window;

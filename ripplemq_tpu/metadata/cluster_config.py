@@ -183,6 +183,50 @@ class ClusterConfig:
     # reference has no analogue: Bolt dispatches on its own pool and
     # every request blocks a JRaft apply anyway.
     rpc_workers: int = 16
+    # --- SLO autopilot (ripplemq_tpu/slo/) -------------------------------
+    # Closed-loop overload control: the produce-ack p99 target in
+    # MILLISECONDS. > 0 starts one control thread per broker
+    # (slo/controller.py) that AIMD-adjusts read_coalesce_s, chain
+    # depth, and the settle window's soft bound against this target,
+    # runs the load-shedding state machine, and records every decision
+    # as slo_* flight-recorder events. 0 (default) disables the loop —
+    # the knobs stay at their static configured values and only the
+    # per-tenant quota buckets (slo_quotas) remain active. Requires
+    # obs=True when enabled (the loop reads the metrics registry).
+    slo_p99_ack_ms: float = 0.0
+    # Control-loop cadence: one measure/adjust/shed decision per tick.
+    slo_tick_s: float = 0.5
+    # The chaos checker's recovery bound: after the LAST heal of a
+    # faulted run, the system must be back in SLO (shedding off, p99
+    # within target) within this window — run_chaos(slo=True) treats a
+    # miss as a first-class violation alongside exactly-once.
+    slo_recover_s: float = 30.0
+    # AIMD rails: the controller never drives a knob outside
+    # [min, max] — the deployment's static values remain legal points
+    # inside them. Chain depth moves on a power-of-two ladder (each
+    # distinct depth is its own compiled device program; the ladder
+    # bounds runtime compiles to log2(max) programs). The settle
+    # window's soft bound lives in [slo_settle_window_min, the
+    # configured engine settle_window].
+    slo_read_coalesce_min_s: float = 0.0
+    slo_read_coalesce_max_s: float = 0.02
+    slo_chain_depth_min: int = 1
+    slo_chain_depth_max: int = 16
+    slo_settle_window_min: int = 1
+    # Shed threshold: settle-window occupancy at or above this fraction
+    # of the EFFECTIVE window is shed evidence; the noisy signals
+    # engage on 2 evidencing ticks within the last 5 (quorum
+    # degradation and stall streaks engage immediately; see
+    # slo/controller.py for the full machine).
+    slo_shed_occupancy: float = 0.75
+    # Per-tenant produce quotas: ((tenant, messages_per_second), ...),
+    # tenant = producer-name prefix before the first "/". A quota is a
+    # per-broker rate CAP (token bucket, one-second burst) and a
+    # PRIORITY CLAIM: while shedding, quota-holding tenants keep their
+    # admission up to their buckets and unquoted (best-effort) traffic
+    # is refused with the retryable `overloaded:` error. YAML:
+    # `slo_quotas: {tenant: rate, ...}`.
+    slo_quotas: tuple = ()
 
     def __post_init__(self) -> None:
         if self.durability not in ("async", "strict"):
@@ -244,6 +288,45 @@ class ClusterConfig:
                 "store_retention_bytes must be at least 2x segment_bytes "
                 "(one sealed + one active segment)"
             )
+        if self.slo_p99_ack_ms < 0:
+            raise ValueError("slo_p99_ack_ms must be >= 0 (0 disables)")
+        if self.slo_p99_ack_ms > 0 and not self.obs:
+            # The control loop measures the ack p99 off the metrics
+            # registry; with obs=False the registry is no-ops and the
+            # loop would fly blind — refuse at parse time.
+            raise ValueError(
+                "slo_p99_ack_ms > 0 requires obs=True: the SLO "
+                "controller reads the live metrics registry"
+            )
+        if self.slo_tick_s <= 0:
+            raise ValueError("slo_tick_s must be > 0")
+        if self.slo_recover_s <= 0:
+            raise ValueError("slo_recover_s must be > 0")
+        if not 0.0 <= self.slo_read_coalesce_min_s \
+                <= self.slo_read_coalesce_max_s:
+            raise ValueError(
+                "slo read-coalesce rails must satisfy 0 <= min <= max"
+            )
+        if not 1 <= self.slo_chain_depth_min <= self.slo_chain_depth_max:
+            raise ValueError(
+                "slo chain-depth rails must satisfy 1 <= min <= max"
+            )
+        if self.slo_settle_window_min < 1:
+            raise ValueError("slo_settle_window_min must be >= 1")
+        if not 0.0 < self.slo_shed_occupancy <= 1.0:
+            raise ValueError("slo_shed_occupancy must be in (0, 1]")
+        for entry in self.slo_quotas:
+            tenant, rate = entry
+            if not isinstance(tenant, str) or not tenant:
+                raise ValueError(
+                    f"slo_quotas tenant must be a non-empty string, "
+                    f"got {tenant!r}"
+                )
+            if float(rate) <= 0:
+                raise ValueError(
+                    f"slo_quotas rate for {tenant!r} must be > 0, "
+                    f"got {rate!r}"
+                )
         if self.linearizable_reads and self.standby_count < 1:
             # The read barrier proves the controller's epoch through the
             # standby ack stream; with no standbys there is no stream to
@@ -359,6 +442,30 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["replication"] = str(raw["replication"])
     if "pid_retention_s" in raw:
         extra["pid_retention_s"] = float(raw["pid_retention_s"])
+    # SLO autopilot knobs (float rails + the int chain/window rails +
+    # the tenant-quota mapping, normalized to a sorted tuple so the
+    # frozen config stays hashable-by-structure and round-trips the
+    # proc-cluster serialization byte-stably).
+    slo_float_keys = (
+        "slo_p99_ack_ms", "slo_tick_s", "slo_recover_s",
+        "slo_read_coalesce_min_s", "slo_read_coalesce_max_s",
+        "slo_shed_occupancy",
+    )
+    for k in slo_float_keys:
+        if k in raw:
+            extra[k] = float(raw[k])
+    slo_int_keys = (
+        "slo_chain_depth_min", "slo_chain_depth_max",
+        "slo_settle_window_min",
+    )
+    for k in slo_int_keys:
+        if k in raw:
+            extra[k] = int(raw[k])
+    if "slo_quotas" in raw:
+        q = raw["slo_quotas"] or {}
+        extra["slo_quotas"] = tuple(
+            sorted((str(t), float(r)) for t, r in dict(q).items())
+        )
     if "coalesce_s" in raw:
         extra["coalesce_s"] = float(raw["coalesce_s"])
     if "read_coalesce_s" in raw:

@@ -55,6 +55,10 @@ EVENT_TYPES = frozenset({
     "host_worker_down", "host_worker_restart",
     # Consumer-group coordinator (manager applies + fencing).
     "group_join", "group_leave", "group_delete", "fence",
+    # SLO autopilot (slo/controller.py): one event per APPLIED knob
+    # adjustment (the control timeline postmortems replay) and the
+    # load-shedding state machine's transitions.
+    "slo_adjust", "slo_shed_on", "slo_shed_off",
 })
 
 

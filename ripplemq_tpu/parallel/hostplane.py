@@ -369,13 +369,30 @@ class _WorkerHandle:
             ) from None
 
     def post(self, op: dict) -> bool:
-        """Fire-and-forget (mirror publish): NEVER blocks the caller —
-        a full queue drops the frame (the worker's contiguity check
-        turns the drop into a clean fallback, not corruption)."""
+        """Fire-and-forget: NEVER blocks the caller — a full queue
+        drops the frame (the worker's contiguity check turns a mirror
+        drop into a clean fallback, not corruption)."""
         if self.dead:
             return False
         try:
             self._sendq.put_nowait((op, None))
+            return True
+        except queue.Full:
+            return False
+
+    def post_parts(self, parts: list) -> bool:
+        """Fire-and-forget scatter-gather publish (the settled-mirror
+        path): `parts` is a pre-encoded frame split as
+        [codec prefix, payload buffer] — the send loop hands it to
+        ShmRing.push_parts so the payload (rows the broker mirror
+        already holds) is copied exactly ONCE, into shared memory,
+        instead of being re-buffered through codec.encode's output
+        bytearray + bytes() snapshot first. Same drop contract as
+        post()."""
+        if self.dead:
+            return False
+        try:
+            self._sendq.put_nowait((parts, None))
             return True
         except queue.Full:
             return False
@@ -394,10 +411,16 @@ class _WorkerHandle:
                 op = dict(op)
                 op["id"] = rid
             try:
-                pushed = self.req_ring.push(
-                    codec.encode(op),
-                    timeout_s=0 if fut is None else 5.0,
-                )
+                if isinstance(op, list):
+                    # Pre-split scatter-gather frame (post_parts): the
+                    # payload part crosses into shared memory directly,
+                    # skipping the encode-buffer re-copy.
+                    pushed = self.req_ring.push_parts(op, timeout_s=0)
+                else:
+                    pushed = self.req_ring.push(
+                        codec.encode(op),
+                        timeout_s=0 if fut is None else 5.0,
+                    )
             except ValueError as e:
                 # Oversize frame: refuse THIS request only — the worker
                 # and every other in-flight op are fine (the submit
@@ -639,15 +662,26 @@ class HostPlane:
     def publish(self, slot: int, base: int, rows) -> None:
         """Fire-and-forget settled-mirror push (settle thread). A drop
         (full queue, dead worker) is safe: the worker's contiguity
-        check resets its window and reads fall back."""
+        check resets its window and reads fall back.
+
+        The rows are published as a REFERENCE + range, not a copy: the
+        frame is pre-split into (encoded header prefix, the row
+        buffer) and ShmRing.push_parts writes both straight into
+        shared memory — the broker mirror already holds these exact
+        bytes (DataPlane._mirror_records), and the old path re-buffered
+        them twice through codec.encode before the one copy that
+        matters (byte parity pinned in tests/test_hostplane.py)."""
         if len(rows) + 256 > self.ring_bytes // 2:
             return  # frame would exceed the ring cap: drop, not kill
         idx = worker_of(slot, self.n_workers)
         with self._lock:
             w = self._workers[idx]
         if w is not None:
-            w.post({"op": "mirror", "slot": int(slot), "base": int(base),
-                    "rows": rows})
+            prefix = codec.encode_dict_with_blob(
+                {"op": "mirror", "slot": int(slot), "base": int(base)},
+                "rows", rows,
+            )
+            w.post_parts([prefix, rows])
 
     def set_worker_pid(self, idx: int, pid: int,
                        gen: Optional[int] = None) -> None:

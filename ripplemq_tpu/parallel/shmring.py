@@ -141,15 +141,14 @@ class ShmRing:
 
     # -- producer side -----------------------------------------------------
 
-    def push(self, body, timeout_s: Optional[float] = 5.0) -> bool:
-        """Publish one frame; False on timeout against a full ring when
-        `timeout_s` is 0 (the non-blocking fire-and-forget mirror path),
-        RingFullError on a positive timeout elapsing."""
-        if self._closed:
-            raise RingClosedError("ring closed")
-        n = len(body)
-        if n == 0 or n > min(MAX_FRAME, self._cap // 2):
-            raise ValueError(f"frame body of {n} bytes out of range")
+    def _reserve(self, n: int, timeout_s: Optional[float]
+                 ) -> Optional[tuple[int, int, int]]:
+        """Wait for `n` contiguous body bytes; returns (tail, idx,
+        need) with any WRAP marker already written — `need` is the
+        8-byte-aligned frame footprint the publish advances tail by —
+        or None when `timeout_s == 0` and the ring is full (the
+        fire-and-forget contract). Raises RingFullError on a positive
+        timeout elapsing."""
         need = _FRAME_HDR + ((n + 7) & ~7)
         deadline = (time.monotonic() + timeout_s) if timeout_s else None
         spins = 0
@@ -162,7 +161,7 @@ class ShmRing:
             if self._cap - (tail - head) >= want:
                 break
             if timeout_s == 0:
-                return False
+                return None
             if deadline is not None and time.monotonic() > deadline:
                 raise RingFullError(
                     f"ring full for {timeout_s}s ({n}-byte frame)"
@@ -176,13 +175,44 @@ class ShmRing:
                 struct.pack_into("<I", self._buf, _HDR_BYTES + idx, _WRAP)
             tail += room_to_end
             idx = 0
+        return tail, idx, need
+
+    def push(self, body, timeout_s: Optional[float] = 5.0) -> bool:
+        """Publish one frame; False on timeout against a full ring when
+        `timeout_s` is 0 (the non-blocking fire-and-forget mirror path),
+        RingFullError on a positive timeout elapsing. One-part alias of
+        push_parts — ONE publish sequence owns the torn-write
+        contract."""
+        return self.push_parts((body,), timeout_s=timeout_s)
+
+    def push_parts(self, parts, timeout_s: Optional[float] = 5.0) -> bool:
+        """Publish ONE frame whose body is the concatenation of `parts`
+        (bytes-like), each copied into the ring exactly once — push()'s
+        single-part case, and the scatter-gather path for bodies whose
+        tail some other buffer already holds (the settled-mirror
+        publish: a ~40-byte encoded header prefix + the row block,
+        wire/codec.py encode_dict_with_blob). No bytes() copies: the
+        slice assignment and the incremental crc32 both take any buffer
+        — the body is touched exactly once each way (the module's
+        design goal, priced per-message in PROFILE.md); byte parity of
+        the split and whole forms is pinned in tests/test_shmring.py."""
+        if self._closed:
+            raise RingClosedError("ring closed")
+        n = sum(len(p) for p in parts)
+        if n == 0 or n > min(MAX_FRAME, self._cap // 2):
+            raise ValueError(f"frame body of {n} bytes out of range")
+        slot = self._reserve(n, timeout_s)
+        if slot is None:
+            return False
+        tail, idx, need = slot
         base = _HDR_BYTES + idx
-        # No bytes() copies: the slice assignment and crc32 both take
-        # any buffer — the frame body is touched exactly once each way
-        # (the module's design goal, priced per-message in PROFILE.md).
-        self._buf[base + _FRAME_HDR : base + _FRAME_HDR + n] = body
-        struct.pack_into("<II", self._buf, base, n,
-                         zlib.crc32(body) & 0xFFFFFFFF)
+        pos = base + _FRAME_HDR
+        crc = 0
+        for p in parts:
+            self._buf[pos : pos + len(p)] = p
+            crc = zlib.crc32(p, crc)
+            pos += len(p)
+        struct.pack_into("<II", self._buf, base, n, crc & 0xFFFFFFFF)
         # Publish point: the 8-byte tail write is the ONLY thing that
         # makes the frame visible (torn-write contract, module doc).
         struct.pack_into("<Q", self._buf, 24, tail + need)

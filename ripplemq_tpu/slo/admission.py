@@ -1,0 +1,133 @@
+"""Admission control: per-tenant token buckets + the shed gate.
+
+The produce RPC surface calls `admit()` FIRST — before partition
+resolution, payload validation, pid stamping, packing, or a
+worker-ring hop — so a refusal under overload costs one dict lookup
+and one refill computation, not the work the refusal exists to avoid.
+
+Tenancy is the producer-name prefix: the SDK registers names like
+`tenant/instance-nonce` (ProducerClient `producer_name`), and the
+segment before the first "/" is the tenant key. `ClusterConfig.
+slo_quotas` maps tenant → messages/second; a quota is both a CAP
+(the bucket refuses a tenant exceeding its rate even when the broker
+is healthy) and a PRIORITY CLAIM (while the shed state machine is
+engaged, quota-holding tenants keep their admission up to their
+buckets and everyone else — the best-effort tier, including pid-less
+raw produces — is refused). Refusals carry the typed retryable
+`overloaded:` prefix so clients jitter-backoff-and-retry instead of
+hammering the refusal path (wire/retry.py).
+
+Quotas are enforced PER BROKER: a tenant's effective cluster rate is
+its quota times the partition-leader brokers it produces to, the same
+per-serving-node semantics as every broker-local limiter (documented
+in the README SLO section). The clock is injectable so tier-1 tests
+drive refill windows with zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ripplemq_tpu.obs.lockwitness import make_lock
+
+
+class TokenBucket:
+    """One tenant's rate state: `rate` tokens/s refill, burst capacity
+    of one second's worth (min 1). take() is called under the
+    admission lock — no internal locking.
+
+    DEBT model for oversize requests: a request is admitted whenever
+    the bucket is positive and charges its FULL size, letting the
+    balance go negative — the tenant then waits out the debt at the
+    refill rate. Requiring `tokens >= n` instead would make any batch
+    larger than one second's rate UNSATISFIABLE BY CONSTRUCTION: the
+    balance caps at `burst`, so the 'retry with backoff' refusal would
+    livelock a healthy in-quota tenant forever. Debt preserves the
+    long-run rate exactly; it just lets one batch front-load it."""
+
+    __slots__ = ("rate", "burst", "tokens", "t")
+
+    def __init__(self, rate: float, now: float) -> None:
+        self.rate = float(rate)
+        self.burst = max(1.0, self.rate)
+        self.tokens = self.burst
+        self.t = now
+
+    def take(self, n: int, now: float) -> bool:
+        if now > self.t:
+            self.tokens = min(self.burst, self.tokens + (now - self.t) * self.rate)
+            self.t = now
+        if self.tokens > 0:
+            self.tokens -= n
+            return True
+        return False
+
+
+class AdmissionController:
+    """The produce front door. `admit()` returns None (admitted) or a
+    human-readable refusal reason the caller wraps as `overloaded: …`.
+
+    The no-quota, not-shedding fast path is two attribute reads and a
+    bool test — the cost every produce pays when the autopilot has
+    nothing to say."""
+
+    def __init__(self, quotas: dict[str, float],
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._clock = clock
+        self._lock = make_lock("AdmissionController._lock")
+        self._quotas = {str(k): float(v) for k, v in dict(quotas or {}).items()}
+        self._buckets: dict[str, TokenBucket] = {}
+        self._shed = False
+        # Counters (racy-read snapshot contract, like obs.metrics):
+        # written under _lock, read bare by stats().
+        self.shed_refusals = 0
+        self.quota_refusals = 0
+
+    @property
+    def shedding(self) -> bool:
+        return self._shed
+
+    def set_shed(self, on: bool) -> None:
+        with self._lock:
+            self._shed = bool(on)
+
+    @staticmethod
+    def tenant_of(producer_name: Optional[str]) -> str:
+        """Producer-name prefix before the first "/" ("" for pid-less /
+        anonymous produces — always the best-effort tier)."""
+        if not producer_name:
+            return ""
+        return str(producer_name).split("/", 1)[0]
+
+    def admit(self, producer_name: Optional[str], n: int) -> Optional[str]:
+        """None = admitted. A string = refusal reason (the caller emits
+        it under the retryable `overloaded:` prefix)."""
+        if not self._shed and not self._quotas:
+            return None  # autopilot quiet: zero-cost front door
+        tenant = self.tenant_of(producer_name)
+        with self._lock:
+            rate = self._quotas.get(tenant)
+            if rate is None:
+                if self._shed:
+                    self.shed_refusals += 1
+                    return (f"shedding best-effort traffic (tenant "
+                            f"{tenant or '<anonymous>'!r} holds no quota); "
+                            f"retry with backoff")
+                return None
+            b = self._buckets.get(tenant)
+            if b is None:
+                b = self._buckets[tenant] = TokenBucket(rate, self._clock())
+            if b.take(max(1, int(n)), self._clock()):
+                return None
+            self.quota_refusals += 1
+            return (f"tenant {tenant!r} over its {rate:g} msg/s quota; "
+                    f"retry with backoff")
+
+    def stats(self) -> dict:
+        return {
+            "shedding": self._shed,
+            "quota_tenants": len(self._quotas),
+            "shed_refusals": self.shed_refusals,
+            "quota_refusals": self.quota_refusals,
+        }

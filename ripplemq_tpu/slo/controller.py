@@ -1,0 +1,378 @@
+"""SloController: the closed loop from live telemetry to operating knobs.
+
+One controller per broker. Every `slo_tick_s` it:
+
+1. **Measures** the tick window's produce-ack p99 by differencing the
+   `produce.ack_us` histogram's log2 bins against the previous tick's
+   snapshot (obs/metrics.py histograms are cumulative; the delta is the
+   window distribution — factor-of-2 resolution, which is what a
+   control loop comparing against a latency target needs).
+2. **Adjusts** (controller broker only — the knobs live on the device
+   plane): AIMD against `slo_p99_ack_ms`. A breach halves the
+   latency-costly knobs (multiplicative decrease: `read_coalesce_s`,
+   chain depth, the settle window's soft bound); a comfortable window
+   (p99 ≤ half the target) walks them back toward throughput
+   (additive: one coalesce step / one window slot; chain depth moves
+   on a power-of-two ladder because each distinct depth is its own
+   compiled device program — the ladder bounds runtime compiles to
+   log2(max) programs). Everything clamps to the ClusterConfig rails
+   (`slo_read_coalesce_min/max_s`, `slo_chain_depth_min/max`,
+   `slo_settle_window_min`). Every applied change is a `slo_adjust`
+   flight-recorder event, so postmortems carry the control timeline.
+3. **Decides shedding**: quorum degradation or a stall
+   streak engages immediately; the sampled/integrated signals need 2
+   evidencing ticks within the last 5 (not necessarily consecutive —
+   see the evidence-window constants below) — settle-window occupancy
+   at ≥
+   `slo_shed_occupancy` of the effective window OR a settle-enqueue
+   backpressure event since the last tick (the COUNTER DELTA, not the
+   instantaneous depth: a stall shorter than one tick still leaves its
+   increments behind, where a sampled gauge reads clean between
+   ticks), or a settle-stage FAILURE since the last tick
+   (`step_errors` delta — the empty-standby-set refusal state shows
+   up here even when membership heals between ticks). A p99 breach
+   alone deliberately does NOT shed: shedding helps when the pipe is
+   QUEUEING (refusing work drains it), and a breach with an empty
+   settle window is structural slowness — boot-time compiles, the
+   worker-hop floor on a starved host — where refusing best-effort
+   traffic forever fixes nothing (observed exactly so while driving
+   this: a 2-core host_workers=2 boot breached a 50 ms target at zero
+   occupancy and shed-flapped a perfectly healthy cluster). The p99
+   window drives the AIMD law instead. Consequence, stated plainly:
+   every shed signal is engine-side, so shedding engages at the
+   CONTROLLER broker's produce surface; a non-controller partition
+   leader's produces feel the overload as engine-append backpressure
+   rather than an early refusal (a frontend-local shed signal that
+   cannot false-positive on structural slowness is a ROADMAP
+   residual). ALL conditions must stay clear for 3 consecutive ticks
+   before shedding disengages (hysteresis — flapping admission is
+   worse than either steady state). Transitions emit
+   `slo_shed_on`/`slo_shed_off` and flip the admission controller's
+   shed gate (slo/admission.py).
+
+The clock and the tick driver are injectable: tier-1 tests construct
+the controller without starting the thread and call `tick()` against a
+scripted metrics feed and a fake plane — zero real sleeps. The thread
+only starts when `slo_p99_ack_ms > 0` (config-validated to require the
+metrics registry).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from ripplemq_tpu.obs.lockwitness import make_lock
+from ripplemq_tpu.slo.admission import AdmissionController
+from ripplemq_tpu.utils.logs import get_logger
+
+log = get_logger("slo")
+
+# Shed-machine shape: evidence-window lengths for the noisy signals
+# and the all-clear hysteresis window.
+# Deliberately NOT config knobs: they parameterize the controller's
+# stability, not the deployment's SLO — a deployment tunes the target,
+# the rails, and the tick, and gets a controller that cannot flap.
+# Noisy-signal evidence window: the sampled/integrated shed signals
+# engage on >= EVIDENCE_MIN evidencing ticks within the last
+# EVIDENCE_WINDOW ticks (client backoff SPACES the symptoms of a
+# sustained fault out — refused rounds arrive at the retry cadence,
+# not every tick — so a consecutive-streak rule reads a persistent
+# outage as a series of one-off blips and never fires).
+EVIDENCE_WINDOW = 5
+EVIDENCE_MIN = 2
+CLEAR_STREAK = 3
+# Minimum ack samples in a tick window before its p99 drives an AIMD
+# knob move (a single straggler must not halve the knobs). The shed
+# machine and the recovery contract use ANY-sample windows instead:
+# their hard-breach evidence needs 2 consecutive windows anyway, and a
+# lone post-heal probe ack is legitimate "back in SLO" evidence.
+MIN_ADJUST_SAMPLES = 4
+# Tick-summary ring depth (wire-encodable; chaos verdicts reconstruct
+# the recovery timeline from it — deep enough to survive the post-heal
+# drain phase between "recovered" and "collected").
+TICK_RING = 512
+TRANSITION_RING = 64
+
+
+class SloController:
+    """See module docstring. `dataplane_fn` returns the local DataPlane
+    iff this broker currently drives the device program (knobs and
+    engine-side shed signals exist only there); `degraded_fn` is the
+    broker's quorum-degradation signal (engine replica quorum lost, or
+    an armed replication plane with zero live standbys)."""
+
+    def __init__(self, config, metrics, recorder,
+                 dataplane_fn: Callable[[], Optional[object]],
+                 degraded_fn: Optional[Callable[[], bool]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall_clock: Callable[[], float] = time.time) -> None:
+        self.enabled = float(config.slo_p99_ack_ms) > 0
+        self.target_ms = float(config.slo_p99_ack_ms)
+        self.tick_s = float(config.slo_tick_s)
+        self.recover_s = float(config.slo_recover_s)
+        self.rc_min = float(config.slo_read_coalesce_min_s)
+        self.rc_max = float(config.slo_read_coalesce_max_s)
+        # Additive-increase step: 16 steps span the rail range, so a
+        # recovered system re-earns its throughput posture over ~16
+        # comfortable ticks instead of snapping back into the breach.
+        self.rc_step = max(1e-4, (self.rc_max - self.rc_min) / 16.0)
+        self.cd_min = int(config.slo_chain_depth_min)
+        self.cd_max = int(config.slo_chain_depth_max)
+        self.sw_min = int(config.slo_settle_window_min)
+        self.shed_occupancy = float(config.slo_shed_occupancy)
+        self.admission = AdmissionController(
+            dict(config.slo_quotas), clock=clock)
+        self._metrics = metrics
+        self._recorder = recorder
+        self._dataplane_fn = dataplane_fn
+        self._degraded_fn = degraded_fn or (lambda: False)
+        self._clock = clock
+        self._wall = wall_clock
+        # The ack histogram OBJECT is resolved once; tick() reads its
+        # bins racy-consistent (the accepted metrics contract). With
+        # the registry disabled there are no bins and every window
+        # reads as no-data (config validation keeps enabled+disabled
+        # from ever combining).
+        self._hist = metrics.histogram("produce.ack_us")
+        self._prev_bins: Optional[list[int]] = None
+        self._lock = make_lock("SloController._lock")
+        # --- state under _lock ---
+        self._shed = False
+        self._shed_count = 0
+        self._adjusts = 0
+        self._ticks = 0
+        # Per-signal evidence rings: 1 per tick the signal evidenced,
+        # trimmed to EVIDENCE_WINDOW (see the module constants).
+        self._occ_ev: list[int] = []
+        self._fail_ev: list[int] = []
+        self._clear_streak = 0
+        # Previous-tick snapshots of the plane's cumulative settle
+        # counters (delta = events since last tick). A controller
+        # failover swaps the plane and resets them to zero — max(0, …)
+        # reads the swap as a quiet tick, not a negative burst.
+        self._prev_step_errors = 0
+        self._prev_backpressure = 0
+        self._last_p99_ms: Optional[float] = None
+        self._last_ok: Optional[bool] = None
+        self._last_reasons: list[str] = []
+        # [t, p99_ms (-1 = no data), ok (1/0, -1 = no data), shed]
+        self._tick_ring: list[list[float]] = []
+        # [t, 1.0 (on) / 0.0 (off)]
+        self._transitions: list[list[float]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="slo-controller",
+        )
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self.enabled:
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(timeout=self.tick_s):
+            try:
+                self.tick()
+            except Exception as e:  # the loop must outlive one bad tick
+                log.warning("slo tick failed: %s: %s", type(e).__name__, e)
+
+    # ------------------------------------------------------------ produce
+
+    def admit(self, producer_name: Optional[str], n: int) -> Optional[str]:
+        """The produce front door (server._handle_produce calls this
+        before any other work). None = admitted."""
+        return self.admission.admit(producer_name, n)
+
+    # ------------------------------------------------------------ the loop
+
+    def _window_p99_ms(self) -> tuple[Optional[float], int]:
+        """(p99 of this tick's ack window in ms, sample count) from the
+        cumulative histogram's bin delta. (None, 0) with no data."""
+        bins = getattr(self._hist, "bins", None)
+        if bins is None:
+            return None, 0
+        cur = list(bins)
+        prev = self._prev_bins
+        self._prev_bins = cur
+        if prev is None:
+            return None, 0
+        delta = [max(0, c - p) for c, p in zip(cur, prev)]
+        count = sum(delta)
+        if count == 0:
+            return None, 0
+        target = 0.99 * count
+        seen = 0
+        for i, b in enumerate(delta):
+            seen += b
+            if seen >= target:
+                return (1 << i) / 1000.0, count
+        return (1 << (len(delta) - 1)) / 1000.0, count
+
+    def tick(self) -> dict:
+        """One control decision. Returns the tick summary (tests drive
+        this directly; the thread discards it)."""
+        t = self._wall()
+        dp = self._dataplane_fn()
+        with self._lock:  # _prev_bins rides the controller's own mutex
+            p99_ms, samples = self._window_p99_ms()
+        ok: Optional[bool] = None
+        if samples >= 1 and p99_ms is not None:
+            ok = p99_ms <= self.target_ms
+        knobs = dp.knob_state() if dp is not None else None
+        bp = se = None
+        if knobs is not None:
+            bp = int(getattr(dp, "settle_backpressure", 0))
+            se = int(getattr(dp, "step_errors", 0))
+        stall_hit = bool(dp is not None and dp.stalled_slots())
+        degraded = bool(self._degraded_fn())
+
+        turn_on_reasons: Optional[list[str]] = None
+        turn_off = False
+        with self._lock:
+            occ_hit = fail_hit = False
+            if knobs is not None:
+                need = max(1, math.ceil(self.shed_occupancy
+                                        * knobs["settle_window"]))
+                # Sampled depth OR the integrated backpressure delta: a
+                # sub-tick stall leaves its counter increments behind.
+                occ_hit = (knobs["settle_inflight"] >= need
+                           or bp > self._prev_backpressure)
+                self._prev_backpressure = bp
+                fail_hit = se > self._prev_step_errors
+                self._prev_step_errors = se
+            self._ticks += 1
+            self._last_p99_ms = p99_ms
+            self._last_ok = ok
+            for ring, hit in ((self._occ_ev, occ_hit),
+                              (self._fail_ev, fail_hit)):
+                ring.append(1 if hit else 0)
+                del ring[:-EVIDENCE_WINDOW]
+            reasons = []
+            if degraded:
+                reasons.append("quorum_degraded")
+            if stall_hit:
+                reasons.append("stall_streak")
+            if sum(self._occ_ev) >= EVIDENCE_MIN:
+                reasons.append("settle_occupancy")
+            if sum(self._fail_ev) >= EVIDENCE_MIN:
+                reasons.append("settle_failures")
+            self._last_reasons = reasons
+            if reasons:
+                self._clear_streak = 0
+                if not self._shed:
+                    self._shed = True
+                    self._shed_count += 1
+                    turn_on_reasons = reasons
+                    self._transitions.append([t, 1.0])
+                    del self._transitions[:-TRANSITION_RING]
+            else:
+                self._clear_streak += 1
+                if self._shed and self._clear_streak >= CLEAR_STREAK:
+                    self._shed = False
+                    turn_off = True
+                    self._transitions.append([t, 0.0])
+                    del self._transitions[:-TRANSITION_RING]
+            shed_now = self._shed
+            self._tick_ring.append([
+                t,
+                -1.0 if p99_ms is None else float(p99_ms),
+                -1.0 if ok is None else (1.0 if ok else 0.0),
+                1.0 if shed_now else 0.0,
+            ])
+            del self._tick_ring[:-TICK_RING]
+        # Transitions act OUTSIDE the controller lock (admission has
+        # its own mutex; the recorder is lock-free).
+        if turn_on_reasons is not None:
+            self.admission.set_shed(True)
+            self._recorder.record(
+                "slo_shed_on", reason=",".join(turn_on_reasons),
+                p99_ms=-1.0 if p99_ms is None else round(p99_ms, 3),
+            )
+            log.warning("slo: load shedding ON (%s; p99=%s ms)",
+                        ",".join(turn_on_reasons), p99_ms)
+        elif turn_off:
+            self.admission.set_shed(False)
+            self._recorder.record(
+                "slo_shed_off",
+                p99_ms=-1.0 if p99_ms is None else round(p99_ms, 3),
+            )
+            log.info("slo: load shedding OFF (p99=%s ms)", p99_ms)
+
+        applied = None
+        if dp is not None and knobs is not None and ok is not None \
+                and samples >= MIN_ADJUST_SAMPLES and self.enabled:
+            applied = self._adjust(dp, knobs, ok, p99_ms, shed_now)
+        return {"t": t, "p99_ms": p99_ms, "samples": samples, "ok": ok,
+                "shed": shed_now, "reasons": reasons, "knobs": applied}
+
+    def _adjust(self, dp, knobs: dict, ok: bool, p99_ms: float,
+                shed: bool) -> Optional[dict]:
+        """The AIMD law (controller broker only). Returns the applied
+        knob state when anything changed, else None."""
+        rc = float(knobs["read_coalesce_s"])
+        cd = int(knobs["chain_depth"])
+        sw = int(knobs["settle_window"])
+        sw_cap = int(knobs["settle_window_cap"])
+        if not ok:
+            # Multiplicative decrease: shed latency posture fast.
+            nrc = max(self.rc_min, rc * 0.5)
+            ncd = max(self.cd_min, cd // 2)
+            nsw = max(self.sw_min, sw // 2)
+        elif p99_ms <= 0.5 * self.target_ms:
+            # Additive increase (chain rides its power-of-two compile
+            # ladder) only with real margin — meeting the target
+            # exactly is equilibrium, not headroom.
+            nrc = min(self.rc_max, rc + self.rc_step)
+            ncd = min(self.cd_max, cd * 2)
+            nsw = min(sw_cap, sw + 1)
+        else:
+            return None
+        if (abs(nrc - rc) < 1e-9) and ncd == cd and nsw == sw:
+            return None
+        applied = dp.set_knobs(read_coalesce_s=nrc, chain_depth=ncd,
+                               settle_window=nsw)
+        with self._lock:
+            self._adjusts += 1
+        self._recorder.record(
+            "slo_adjust",
+            p99_ms=round(p99_ms, 3), ok=bool(ok), shed=bool(shed),
+            read_coalesce_us=int(applied["read_coalesce_s"] * 1e6),
+            chain_depth=int(applied["chain_depth"]),
+            settle_window=int(applied["settle_window"]),
+        )
+        return applied
+
+    # ------------------------------------------------------------ surface
+
+    def stats(self) -> dict:
+        """The admin.stats `slo` block: mode, current knob values, shed
+        counts, and the tick/transition history chaos verdicts replay
+        (wire-encodable)."""
+        dp = self._dataplane_fn()
+        knobs = dp.knob_state() if dp is not None else None
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "mode": ("off" if not self.enabled
+                         else "shed" if self._shed else "steady"),
+                "target_p99_ms": self.target_ms,
+                "p99_ms": self._last_p99_ms,
+                "meeting_slo": self._last_ok,
+                "ticks": self._ticks,
+                "adjustments": self._adjusts,
+                "shed_count": self._shed_count,
+                "shed_reasons": list(self._last_reasons),
+                "admission": self.admission.stats(),
+                "knobs": knobs,
+                "transitions": [list(x) for x in self._transitions],
+                "tick_history": [list(x) for x in self._tick_ring],
+            }

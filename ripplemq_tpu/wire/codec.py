@@ -217,6 +217,51 @@ def encode(v, bulk: bool = True) -> bytes:
     return raw
 
 
+def encode_dict_with_blob(meta: dict, key: str, blob) -> bytes:
+    """PREFIX bytes such that `prefix + blob` is byte-identical to
+    `encode({**meta, key: bytes(blob)})` with the blob entry LAST.
+
+    The scatter-gather half of the settled-mirror publish path
+    (parallel/hostplane.py): the mirror rows already live in the
+    broker's host mirror, and `encode()` would copy them TWICE more
+    (bytearray append + the final bytes() snapshot) just to prepend a
+    ~40-byte header. With this prefix the caller hands
+    `[prefix, rows]` to ShmRing.push_parts and the payload is touched
+    exactly once — the copy into shared memory. decode() cannot tell
+    the two forms apart (tests/test_shmring.py pins byte parity).
+
+    Stats account the LOGICAL frame (prefix + blob), mirroring
+    encode()."""
+    stats = _STATS_ENABLED
+    t0 = time.perf_counter_ns() if stats else 0
+    if key in meta:
+        raise ValueError(f"blob key {key!r} duplicates a meta key")
+    if type(blob) is memoryview:
+        blob = _flat_view(blob)
+    out = bytearray()
+    out += _DICT
+    _write_varint(out, len(meta) + 1)
+    for k, item in meta.items():
+        if not isinstance(k, str):
+            raise TypeError(f"dict keys must be str, got {type(k).__name__}")
+        raw = k.encode("utf-8")
+        _write_varint(out, len(raw))
+        out += raw
+        _encode_into(out, item, True)
+    raw = key.encode("utf-8")
+    _write_varint(out, len(raw))
+    out += raw
+    out += _BYTES
+    _write_varint(out, len(blob))
+    prefix = bytes(out)
+    if stats:
+        s = _STATS
+        s.encode_ns += time.perf_counter_ns() - t0
+        s.encode_frames += 1
+        s.encode_bytes += len(prefix) + len(blob)
+    return prefix
+
+
 def _read_length(buf: memoryview, pos: int) -> tuple[int, int]:
     """Decode a length/count prefix, rejecting malformed frames cleanly: a
     negative decoded length would make buf[pos:pos+n] silently yield an

@@ -101,6 +101,14 @@ RETRYABLE_ERROR_PREFIXES = (
     # flight): the sender rewinds onto the standby's expected counter
     # and re-delivers in order.
     "repl_seq_gap",
+    # SLO admission refusal (slo/admission.py): the broker is shedding
+    # best-effort traffic or the tenant's token bucket is empty —
+    # transient by construction, and the refusal exists precisely so
+    # clients BACK OFF (the jittered exponential backoff is the
+    # admission controller's other half; a fatal classification would
+    # drop acked-workload retries on the floor, a bare retry storm
+    # would defeat the shed).
+    "overloaded",
     "internal",             # unexpected exception; timing-dependent
 )
 

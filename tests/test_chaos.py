@@ -24,6 +24,8 @@ from ripplemq_tpu.chaos.nemesis import (
     trace_json,
 )
 
+from tests.helpers import assert_chaos_liveness
+
 SMOKE_SEEDS = (1, 3, 7)
 PHASES = 2
 
@@ -72,10 +74,10 @@ def test_fixed_seed_chaos_smoke(seed):
     srcs = {e["src"] for e in tl}
     assert "nemesis" in srcs and any(s.startswith("broker") for s in srcs)
     assert [e["t"] for e in tl] == sorted(e["t"] for e in tl)
-    assert verdict["converged"], (
-        f"seed {seed} never re-converged after heal: "
-        f"{verdict['convergence']}"
-    )
+    # Convergence gated on the documented contention flake class (the
+    # gate is semantic — safety clean AND the drain served the full
+    # log — not a wider timeout; see helpers.assert_chaos_liveness).
+    assert_chaos_liveness(verdict)
     # The workload actually exercised the cluster through the faults.
     # Mid-run consume/delivery counts are contention-sensitive (a
     # consumer can spend a short faulted run inside retry stalls), so
@@ -118,7 +120,7 @@ def test_striped_chaos_smoke():
     assert verdict["lock_witness"]["acyclic"]
     assert verdict["lock_witness"]["uncovered_edges"] == []
     assert "StripeReplicator._lock" in verdict["lock_witness"]["locks"]
-    assert verdict["converged"], verdict["convergence"]
+    assert_chaos_liveness(verdict)
     ops = [t["op"] for t in verdict["trace"]]
     assert "stripe_kill" in ops and "disk_flip" in ops
     assert "restart_holder" in ops  # holder-indexed restart in trace

@@ -180,6 +180,35 @@ def test_config_yaml_dict_round_trips_every_field():
                  "max_offset_updates", "settle_window"):
         assert getattr(parsed.engine, name) == getattr(config.engine, name)
 
+    # The SLO-autopilot fields ride a SECOND config: slo_p99_ack_ms > 0
+    # is config-validated to require obs=True, and the first config's
+    # non-default obs=False is itself load-bearing above — the two
+    # non-default choices cannot coexist in one value.
+    slo_config = dataclasses.replace(
+        config,
+        obs=True,
+        slo_p99_ack_ms=17.0,
+        slo_tick_s=0.25,
+        slo_recover_s=21.0,
+        slo_read_coalesce_min_s=0.0005,
+        slo_read_coalesce_max_s=0.011,
+        slo_chain_depth_min=2,
+        slo_chain_depth_max=8,
+        slo_settle_window_min=2,
+        slo_shed_occupancy=0.6,
+        slo_quotas=(("gold", 500.0), ("silver", 50.0)),
+    )
+    parsed2 = parse_cluster_config(
+        yaml.safe_load(yaml.safe_dump(_config_yaml_dict(slo_config)))
+    )
+    for f in dataclasses.fields(ClusterConfig):
+        if f.name == "engine":
+            continue
+        assert getattr(parsed2, f.name) == getattr(slo_config, f.name), (
+            f"ClusterConfig.{f.name} lost in the proc-cluster "
+            f"serialization round trip (slo config)"
+        )
+
 
 def test_cli_rejects_bad_config(tmp_path):
     bad = tmp_path / "bad.yaml"

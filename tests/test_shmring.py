@@ -135,6 +135,53 @@ def test_full_ring_backpressure_and_nonblocking_drop():
         prod.close()
 
 
+def test_push_parts_byte_parity_with_push():
+    """The scatter-gather publish (push_parts — the settled-mirror
+    reference/range path, ISSUE 13 satellite) is BYTE-IDENTICAL on the
+    consumer side to push() of the concatenated body: same framing,
+    same CRC, interleavable on one ring, correct across wraps."""
+    prod, cons = make_pair(1 << 12)
+    try:
+        for i in range(500):  # many wraps of the 4 KiB ring
+            prefix = bytes([i % 7]) * (i % 37 + 1)
+            blob = bytes([i % 251]) * (i % 300 + 1)
+            if i % 2:
+                assert prod.push_parts([prefix, blob], timeout_s=2.0)
+            else:
+                assert prod.push(prefix + blob, timeout_s=2.0)
+            got = cons.pop(timeout_s=2.0)
+            assert bytes(got) == prefix + blob, f"frame {i} corrupted"
+        # memoryview parts cross without materializing.
+        assert prod.push_parts(
+            [memoryview(b"head"), memoryview(b"tail")], timeout_s=1.0)
+        assert bytes(cons.pop(timeout_s=1.0)) == b"headtail"
+        # Same refusal contract as push.
+        with pytest.raises(ValueError):
+            prod.push_parts([b""], timeout_s=1.0)
+        with pytest.raises(ValueError):
+            prod.push_parts([b"x" * (1 << 12)], timeout_s=1.0)
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_encode_dict_with_blob_parity():
+    """codec.encode_dict_with_blob(meta, key, blob) + blob must be
+    byte-for-byte the frame codec.encode builds for the same dict with
+    the blob entry last — the decoder cannot tell which path produced
+    it (the settled-mirror publish rides the split form)."""
+    from ripplemq_tpu.wire import codec
+
+    meta = {"op": "mirror", "slot": 3, "base": 4096}
+    for blob in (b"", b"x", b"\x00" * 1000, bytes(range(256)) * 5):
+        prefix = codec.encode_dict_with_blob(meta, "rows", blob)
+        whole = codec.encode({**meta, "rows": blob})
+        assert prefix + blob == whole
+        assert codec.decode(prefix + blob) == {**meta, "rows": blob}
+    with pytest.raises(ValueError):
+        codec.encode_dict_with_blob({"rows": 1}, "rows", b"z")
+
+
 def test_occupancy_gauge():
     prod, cons = make_pair(1 << 12)
     try:

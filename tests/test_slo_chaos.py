@@ -1,0 +1,114 @@
+"""Fixed-seed chaos smoke with the SLO autopilot engaged (tier-1,
+ISSUE 13 acceptance): the degradation contract as a checker invariant.
+
+The schedule crashes BOTH replication standbys of the 3-broker cluster
+(the lowest-id rule makes brokers 1 and 2 the standby set for
+controller 0). That also takes the metadata raft below its majority —
+deliberately: with a quorum the cluster SELF-HEALS in under half a
+second (liveness prunes the dead standbys and admits replacements —
+measured while building this smoke, and exactly what PR 2's fault
+tolerance promises), which is a blip, not a sustained fault. Without
+one, nothing can re-plan membership: the settle path waits dead
+members' acks for the whole phase — the window fills (occupancy +
+backpressure evidence), every round times out (settle-failure
+evidence), and produce acks stretch to their deadlines (p99 evidence).
+The contract asserted from the verdict's `slo` section (its misses are
+first-class violations inside `violations`):
+
+1. shed mode ENGAGES within a bounded window of the sustained fault;
+2. acked traffic never violates safety while shedding (the ordinary
+   unconditional checker — shedding changes admission, never settled
+   state);
+3. the system RETURNS TO SLO within `slo_recover_s` of heal (shed off,
+   p99 back inside the target).
+
+Wall-clock-bounded halves are gated on the documented contention flake
+class exactly like the convergence probe (helpers.assert_chaos_liveness
+— a throttled tier-1 host stretches real seconds, not correctness).
+"""
+
+from __future__ import annotations
+
+from ripplemq_tpu.chaos.nemesis import trace_json
+from tests.helpers import assert_chaos_liveness
+
+SEED = 13
+
+
+def test_fixed_seed_slo_chaos_smoke():
+    from ripplemq_tpu.chaos import run_chaos
+
+    schedule = [
+        [{"op": "crash", "broker": 1}, {"op": "crash", "broker": 2}],
+    ]
+    verdict = run_chaos(
+        seed=SEED, n_brokers=3, phases=1, phase_s=2.5,
+        schedule=schedule, converge_timeout_s=90.0,
+        slo=True, slo_target_p99_ms=100.0,
+        # This schedule is DECLARED overloading: shed-engagement is a
+        # violation if it never happens (random-pool soaks leave
+        # expect_shed off — a gentle seed the plane absorbs without
+        # distress is the system working).
+        slo_expect_shed=True,
+        # Generous bounds: the contract is "bounded and honest", and a
+        # contended tier-1 host must not convert real seconds into red.
+        slo_shed_bound_s=20.0, slo_recover_s=60.0,
+    )
+    slo = verdict["slo"]
+    # Safety first, and shed/recovery misses land in violations too —
+    # but split the wall-clock-bounded liveness halves out so the
+    # contention gate can judge them (same discipline as convergence).
+    hard = [v for v in verdict["violations"] if not v.startswith("slo:")]
+    assert hard == [], (
+        f"safety violations with slo engaged: {hard}\n"
+        f"trace: {trace_json(verdict['trace'])}"
+    )
+    if any(v.startswith("slo:") for v in verdict["violations"]):
+        # An slo-contract miss on a contended host shows the same
+        # signature as a missed convergence probe; the gate skips with
+        # it or fails hard when the cluster is genuinely wedged. The
+        # slo: entries themselves are stripped from the view the gate
+        # sees — its skip branch requires an otherwise-clean verdict,
+        # and `hard == []` was asserted just above (leaving them in
+        # would make the skip unreachable and reintroduce the flake).
+        assert_chaos_liveness(
+            {**verdict, "converged": False, "violations": hard},
+            what="slo contract",
+        )
+    # The reaction half: shedding engaged under the fault, within
+    # bound, and produces were actually REFUSED cheap-and-early with
+    # the typed retryable `overloaded:` error (the workload producer is
+    # best-effort — no quota — so the shed gate hits it).
+    assert slo["shed_engaged"], slo
+    assert slo["shed_engaged_after_s"] is not None
+    assert slo["refused"] > 0, (
+        f"shed engaged but no produce was refused: {slo}"
+    )
+    # The recovery half: back in SLO after heal, nobody still shedding.
+    assert slo["recovered_within_s"] is not None, slo
+    assert all(m != "shed" for m in slo["final_modes"].values()), slo
+    # The loop was alive on every broker (ticks advanced) and the
+    # controller broker exposed its knob state.
+    assert all(pb["ticks"] > 0 for pb in slo["per_broker"].values())
+    assert any(pb["knobs"] is not None
+               for pb in slo["per_broker"].values()), (
+        "no broker reported the controller knob surface"
+    )
+    # Convergence, contention-gated like every other smoke.
+    assert_chaos_liveness(verdict)
+    assert verdict["counts"]["produce_ok"] > 0
+    assert sum(verdict["final_log_sizes"].values()) > 0
+
+
+def test_slo_section_absent_without_flag():
+    """run_chaos without slo= must not grow the verdict (the section is
+    an opt-in contract, not ambient noise) — cheap shape pin riding the
+    checker-unit budget, no cluster boot."""
+    from ripplemq_tpu.chaos.harness import check_slo
+
+    # And the checker itself: no stats blocks at all is a violation
+    # (a run that looks slo-checked but collected nothing must not
+    # read as clean).
+    section, violations = check_slo({}, [], 10.0, 30.0)
+    assert violations and "no broker" in violations[0]
+    assert section["shed_engaged"] is False
