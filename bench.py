@@ -1522,6 +1522,149 @@ def _run_group_consume(n_groups: int = 3, members: int = 2,
         }
 
 
+def _run_consume_fanout(consumer_counts: tuple[int, ...] = (4, 16),
+                        partitions: int = 2, n_msgs: int = 480) -> dict:
+    """Fan-out consume A/B (ISSUE 16): C independent consumers each
+    drain the SAME pre-produced log end to end — the multi-subscriber
+    workload where every cursor historically funneled through one
+    partition leader — with follower reads OFF vs ON, sweeping the
+    consumer count. Each arm boots a fresh 3-broker PROCESS cluster
+    (real TCP, one OS process per broker: the shape where serving
+    reads from standbys buys actual CPU parallelism; in-proc brokers
+    share one GIL and would price only the extra hop), produces the
+    full log once, waits for the replication floors to settle on the
+    standbys, then fans the consumers out. COUNT-EXACT per arm: every
+    consumer must read exactly `n_msgs` rows (per-consumer offsets —
+    each cursor is its own group re-reading the topic); anything else
+    fails the bench. ON arms also report how many deliveries the
+    followers actually served — an ON arm the leader quietly absorbed
+    would otherwise read as a null A/B. `host_cores` records the
+    parallelism physically available: like the host-plane sweep
+    (PROFILE.md round 12), a 1–2 core container serializes the three
+    broker processes onto one clock and the curve prices the plane's
+    OVERHEAD (extra hop, refusal fallbacks); the ≥4-core reading is
+    where spreading reads over standbys buys throughput."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from ripplemq_tpu.chaos.proc_cluster import (
+        ProcCluster,
+        free_ports,
+        make_proc_cluster_config,
+    )
+    from ripplemq_tpu.client import ConsumerClient, ProducerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    per_part = n_msgs // partitions
+    total_msgs = per_part * partitions
+
+    def one_arm(consumers: int, follower: bool) -> dict:
+        tmp = _tempfile.mkdtemp(prefix="fanout-")
+        config = make_proc_cluster_config(
+            free_ports(3), topics=(Topic("fanout", partitions, 3),),
+            follower_reads=follower,
+        )
+        cluster = ProcCluster(config=config, data_dir=tmp)
+        try:
+            cluster.start()
+            cluster.wait_for_leaders()
+            deadline = time.time() + 120
+            while time.time() < deadline and not cluster.controller_ready():
+                time.sleep(0.1)
+            bootstrap = [b.address for b in config.brokers]
+            producer = ProducerClient(
+                bootstrap, transport=cluster.client("fanout-p"),
+                rpc_timeout_s=10.0,
+            )
+            B = config.engine.max_batch
+            for pid in range(partitions):
+                payloads = [b"f-%d-%06d" % (pid, i)
+                            for i in range(per_part)]
+                for i in range(0, per_part, B):
+                    producer.produce_batch("fanout", payloads[i:i + B],
+                                           partition=pid)
+            producer.close()
+            # Let the replication stream land the floor stamps on the
+            # standbys before the read storm: follower serving is gated
+            # on the floor, and an arm racing it would measure leader
+            # fallbacks, not the plane.
+            time.sleep(1.5)
+
+            counts = [0] * consumers
+            served = [0] * consumers
+            fail: list[str] = []
+
+            def member(ci: int) -> None:
+                cc = ConsumerClient(
+                    bootstrap, f"fan-{ci}",
+                    transport=cluster.client(f"fan-{ci}"),
+                    rpc_timeout_s=10.0, follower_reads=follower,
+                )
+                try:
+                    empties = 0
+                    while counts[ci] < total_msgs and empties < 200:
+                        msgs = cc.consume("fanout", max_messages=16)
+                        if msgs:
+                            counts[ci] += len(msgs)
+                            empties = 0
+                        else:
+                            empties += 1
+                            time.sleep(0.01)
+                    served[ci] = cc.follower_served
+                except Exception as e:
+                    fail.append(f"consumer {ci}: {type(e).__name__}: {e}")
+                finally:
+                    cc.close()
+
+            threads = [
+                _threading.Thread(target=member, args=(ci,), daemon=True)
+                for ci in range(consumers)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            elapsed = time.perf_counter() - t0
+            if fail or any(c != total_msgs for c in counts):
+                raise AssertionError(
+                    f"fan-out arm (consumers={consumers}, "
+                    f"follower={follower}) not count-exact: wanted "
+                    f"{total_msgs}/consumer, got {counts}; errors: {fail}"
+                )
+            return {
+                "consumers": consumers,
+                "follower_reads": follower,
+                "msgs_per_sec": round(consumers * total_msgs / elapsed, 1),
+                "elapsed_s": round(elapsed, 3),
+                "follower_served": sum(served),
+                "count_exact": True,
+            }
+        finally:
+            cluster.stop()
+            _shutil.rmtree(tmp, ignore_errors=True)
+
+    arms = [one_arm(c, f) for c in consumer_counts for f in (False, True)]
+    by_count = {}
+    for c in consumer_counts:
+        off = next(a for a in arms
+                   if a["consumers"] == c and not a["follower_reads"])
+        on = next(a for a in arms
+                  if a["consumers"] == c and a["follower_reads"])
+        by_count[str(c)] = round(
+            on["msgs_per_sec"] / off["msgs_per_sec"], 2)
+    import os as _os
+
+    return {
+        "arms": arms,
+        "msgs_per_consumer": total_msgs,
+        "partitions": partitions,
+        "speedup_on_vs_off": by_count,
+        "host_cores": _os.cpu_count(),
+    }
+
+
 def _run_slo_convergence(target_ms: float = 25.0, light_s: float = 1.5,
                          heavy_s: float = 10.0) -> dict:
     """SLO autopilot time-to-SLO after a STEP-LOAD change (ISSUE 13):
@@ -1884,6 +2027,9 @@ def main() -> None:
     group_consume = _run_group_consume()
     # ISSUE 13: SLO autopilot time-to-SLO after a step-load change.
     slo_convergence = _run_slo_convergence()
+    # ISSUE 16: fan-out consume A/B — follower reads OFF vs ON over
+    # subprocess brokers, consumer-count sweep, count-exact per arm.
+    consume_fanout = _run_consume_fanout()
     e2e = _run_e2e()
     # ISSUE 12: the multi-core host plane's same-host worker sweep
     # (workers 1/2/4, subprocess clients everywhere, count-exact).
@@ -1918,6 +2064,7 @@ def main() -> None:
                 "readback": "verified",
                 "host_plane_scaling": host_plane_scaling,
                 "slo_convergence": slo_convergence,
+                "consume_fanout": consume_fanout,
                 **group_consume,
                 **e2e,
             }
@@ -1931,5 +2078,10 @@ if __name__ == "__main__":
     if len(_sys.argv) > 2 and _sys.argv[1] == "_e2e_client":
         # e2e loadgen subprocess (jax-free): see _e2e_client_main.
         _e2e_client_main(_sys.argv[2])
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "consume_fanout":
+        # Standalone fan-out A/B (the brokers are subprocesses; this
+        # process never touches jax) — runnable without the full bench:
+        #     python bench.py consume_fanout
+        print(json.dumps({"consume_fanout": _run_consume_fanout()}))
     else:
         main()

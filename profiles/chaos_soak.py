@@ -95,6 +95,16 @@ def main() -> int:
                          "while shedding, recovery to SLO within "
                          "slo_recover_s of heal — is checked as "
                          "first-class violations")
+    ap.add_argument("--follower-reads", action="store_true",
+                    help="run the cluster with the follower-read plane "
+                         "on (broker/follower.py) and the workload "
+                         "consumer routing through it (backlogged reads "
+                         "go to leased standbys, refusals fall back to "
+                         "the leader); the verdict gains a `follower` "
+                         "section, and a follower answering above its "
+                         "replicated settled floor is a first-class "
+                         "violation; works on both backends and both "
+                         "replication modes")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON file holding a recorded trace (or a full "
                          "verdict) to re-apply instead of generating "
@@ -151,6 +161,7 @@ def main() -> int:
             lock_witness=args.witness,
             host_workers=args.host_workers,
             slo=args.slo,
+            follower_reads=args.follower_reads,
             # Process boots (JAX import + XLA compiles per broker) put
             # convergence probes on a different clock than in-proc runs.
             converge_timeout_s=120.0 if args.backend == "proc" else 30.0,

@@ -41,6 +41,8 @@ FAST_MODULES = {
     "test_dataplane",
     "test_degradation",
     "test_failover",
+    "test_follower_reads",      # ~50 s: plane/lease units, 2-mode byte
+                                # identity, 3 chaos smokes (1 proc)
     "test_graft",
     "test_groups",              # ~30 s: coordinator units + one cluster run
     "test_hostplane",           # ~15 s: worker spawns are jax-free (~100 ms)

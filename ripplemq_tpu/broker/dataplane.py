@@ -106,6 +106,12 @@ _OFFSET_HORIZON = (1 << 31) - (1 << 20)
 # Sentinel: a host-cache read lost the trim race mid-copy (see
 # DataPlane._read_cache).
 _CACHE_LAPPED = object()
+# Distinct from the dirty-shadow None: the offset sits in a MIRROR-GAP
+# window (resolve failure disabled the cache for the slot), where the
+# rows are settled — hence persisted and log-indexed — and the store can
+# serve them without a device dispatch (see read()'s gap-generation
+# probe discipline).
+_CACHE_GAP = object()
 
 # Settled batches remembered per (pid, slot) for producer-sequence
 # dedup. The producer only ever replays sequences it never saw acked —
@@ -264,6 +270,17 @@ class DataPlane:
         # it is then store-served and never consults the mirror), rather
         # than staying disabled for the slot's lifetime.
         self._mirror_gap: dict[int, list[int]] = {}
+        # Monotone per-slot gap GENERATION: bumped each time a fresh
+        # mirror gap opens. The read path device-probes a gap window
+        # once per generation (the probe validates the window against
+        # the device commit bound) and then serves the store path
+        # directly for the rest of that gap's lifetime — settled rows
+        # are always persisted+indexed before they are mirrored
+        # (_release_one order), so the store is a valid authority
+        # inside the gap and the per-call device round-trip was pure
+        # overhead.
+        self._mirror_gap_gen: dict[int, int] = {}
+        self._gap_probed_gen: dict[int, int] = {}
         # Per-slot SETTLED GAPS (the mirror-gap analogue for the read
         # horizon): sorted disjoint [begin, end) absolute row ranges that
         # are device-committed but whose standby replication FAILED —
@@ -671,6 +688,24 @@ class DataPlane:
         the array bare)."""
         with self._lock:
             return int(self._settled_end[slot])
+
+    def settle_floors(self, slots) -> list[list]:
+        """Per-slot settled-floor stamp for the replication sender
+        (follower reads, ISSUE 16): `[[slot, settled_end, gaps], ...]`
+        for the requested slots, snapshotted in ONE pass under the
+        plane's lock so a frame never carries a floor that is newer
+        than the gap map it rode with (a follower trusting such a pair
+        could serve a nacked row the gap entry would have fenced).
+        Floors are conservative by construction — the settle pipeline
+        advances `_settled_end` only after the round's standby acks
+        landed, so every offset at-or-below a stamped floor is already
+        replicated to the whole (full-copy) standby set."""
+        with self._lock:
+            return [
+                [int(s), int(self._settled_end[s]),
+                 [list(g) for g in self._settled_gaps.get(s, ())]]
+                for s in slots
+            ]
 
     def log_end(self, slot: int) -> int:
         """The slot's host-shadow log end (device-committed absolute
@@ -1212,6 +1247,32 @@ class DataPlane:
                 res = self._read_cache(slot, offset, max_msgs)
                 if res is _CACHE_LAPPED:
                     continue  # trim overran the window mid-copy: store-serve
+                if res is _CACHE_GAP:
+                    # Mirror-gap window: device-probe ONCE per gap
+                    # generation (the probe re-validates the window
+                    # against the device commit bound), then serve the
+                    # store path directly for the gap's remaining
+                    # lifetime — settled rows are persisted and indexed
+                    # BEFORE they are mirrored (_release_one order), so
+                    # the previous per-call device round-trip here was
+                    # pure overhead.
+                    with self._lock:
+                        gen = self._mirror_gap_gen.get(slot, 0)
+                        probed = self._gap_probed_gen.get(slot) == gen
+                        self._gap_probed_gen[slot] = gen
+                    if probed and self.log_index is not None:
+                        try:
+                            got = self._read_store(slot, offset, max_msgs)
+                        except StoreReadRaceError:
+                            got = None  # GC churn: the device re-serves
+                        if got is not None:
+                            msgs_got, nxt_got = got
+                            if not msgs_got and nxt_got > offset:
+                                offset = nxt_got  # all-padding: walk on
+                                continue
+                            self._m_read_msgs.inc(len(msgs_got))
+                            return got
+                    res = None  # first probe this gap: device authority
                 if res is not None:
                     msgs_res, nxt_res = res
                     if not msgs_res and nxt_res > offset:
@@ -1277,9 +1338,12 @@ class DataPlane:
     ) -> Optional[tuple[list[bytes], int]]:
         """Serve one hot read from the host ring mirror. Returns the
         (messages, next_offset) result, None to fall through to the
-        device (mirror gap after a resolve failure), or _CACHE_LAPPED
-        when trim overran the window mid-copy (caller retries; the next
-        pass store-serves). An offset at-or-past the SETTLED end answers
+        device (dirty log-end shadow: the device commit bound is the
+        authority), _CACHE_GAP when the offset sits in a mirror-gap
+        window (resolve failure — caller probes the device once per gap
+        generation, then store-serves), or _CACHE_LAPPED when trim
+        overran the window mid-copy (caller retries; the next pass
+        store-serves). An offset at-or-past the SETTLED end answers
         empty WITHOUT device dispatch: reads may never see past the
         settled horizon anyway (a device dispatch would clamp to it and
         return the same emptiness), so tail polls stay host-authoritative
@@ -1309,7 +1373,7 @@ class DataPlane:
         if offset >= end:
             return [], offset  # caught up: nothing committed past offset
         if offset >= cend:
-            return None  # mirror gap: the device ring is the authority
+            return _CACHE_GAP  # mirror gap: store/device is the authority
         pos = offset % S
         k = min(end - offset, cend - offset, self.cfg.read_batch, gap_room)
         if pos + k <= S:
@@ -2413,6 +2477,9 @@ class DataPlane:
                 g = self._mirror_gap.get(slot)
                 if g is None or base > g[1]:
                     g = self._mirror_gap[slot] = [base, new_end]
+                    self._mirror_gap_gen[slot] = (
+                        self._mirror_gap_gen.get(slot, 0) + 1
+                    )
                 else:
                     g[1] = max(g[1], new_end)
                 if int(self.trim[slot]) >= g[0]:

@@ -103,6 +103,15 @@ OP_GROUP_DELETE = "group_delete"
 # a full copy of its committed-round stream (the standby set).
 OP_SET_CONTROLLER = "set_controller"
 OP_SET_STANDBYS = "set_standbys"
+# Follower-read leases (broker/follower.py): which standbys may answer
+# consumes from their replicated settled floor, and under WHICH
+# controller epoch. The grant is {broker_id: epoch}; an apply whose
+# epoch is not the current controller epoch is ignored, and every
+# controller handover clears the whole table — a deposed generation's
+# lease can never authorize serving past the new generation's trim/gap
+# map. Brokers re-check the lease per answered read (server.py), so
+# revocation is one metadata round, not a timeout.
+OP_SET_FOLLOWER_LEASES = "set_follower_leases"
 # N commands applied atomically as ONE hostraft entry. Exists because a
 # thousand-partition election wave must not pay a thousand per-entry
 # proposal/broadcast costs: the controller advertises every winner of a
@@ -172,6 +181,11 @@ class PartitionManager:
         # stripe_assignment; recovery still asks every live broker, so
         # the map is routing truth, not a safety dependency).
         self.stripe_holders: tuple[int, ...] = ()
+        # Follower-read leases: standby broker → controller epoch the
+        # lease was granted under (OP_SET_FOLLOWER_LEASES). Only entries
+        # matching the CURRENT epoch authorize serving; the table is
+        # cleared on every controller handover.
+        self.follower_leases: dict[int, int] = {}
         # Election debounce: slot → when it was first seen leaderless.
         # A partition must stay leaderless for config.election_timeout_s
         # before the controller ballots it (the role JRaft's per-group
@@ -238,6 +252,11 @@ class PartitionManager:
             self._apply_set_standbys(
                 int(cmd["epoch"]), [int(b) for b in cmd["standbys"]]
             )
+        elif op == OP_SET_FOLLOWER_LEASES:
+            self._apply_set_follower_leases(
+                int(cmd["epoch"]),
+                {int(b): int(e) for b, e in dict(cmd["leases"]).items()},
+            )
         # Unknown ops are ignored (forward compatibility).
 
     def snapshot(self) -> dict:
@@ -256,6 +275,9 @@ class PartitionManager:
                 "controller_epoch": self.controller_epoch,
                 "standbys": list(self.standbys),
                 "stripe_holders": list(self.stripe_holders),
+                "follower_leases": {
+                    str(b): int(e) for b, e in self.follower_leases.items()
+                },
             }
 
     def restore(self, state: dict) -> None:
@@ -288,6 +310,11 @@ class PartitionManager:
                     "stripe_holders", stripe_assignment(self.standbys)
                 )
             )
+            # Pre-follower-reads snapshots: no leases were granted.
+            self.follower_leases = {
+                int(b): int(e)
+                for b, e in state.get("follower_leases", {}).items()
+            }
             self._apply_set_topics(
                 topics_from_wire(state["topics"]),
                 [int(b) for b in state["live"]],
@@ -304,6 +331,10 @@ class PartitionManager:
         self.controller_epoch = epoch
         self.standbys = tuple(b for b in standbys if b != controller)
         self.stripe_holders = stripe_assignment(self.standbys)
+        # Generation fence: every handover revokes ALL follower-read
+        # leases — the new controller's duty re-grants to the standbys
+        # it trusts, under the new epoch.
+        self.follower_leases = {}
 
     def _apply_set_standbys(self, epoch: int, standbys: list[int]) -> None:
         """Standby-set rewrite, valid only within the current epoch."""
@@ -313,6 +344,25 @@ class PartitionManager:
             b for b in standbys if b != self.controller_broker
         )
         self.stripe_holders = stripe_assignment(self.standbys)
+        # Brokers dropped from the standby set stop replicating — their
+        # floor parks, so their lease goes with their membership.
+        self.follower_leases = {
+            b: e for b, e in self.follower_leases.items()
+            if b in self.standbys
+        }
+
+    def _apply_set_follower_leases(
+        self, epoch: int, leases: dict[int, int]
+    ) -> None:
+        """Install the follower-read lease table, valid only within the
+        current controller epoch (a stale grant — proposed before a
+        handover committed — must not authorize the old generation)."""
+        if epoch != self.controller_epoch:
+            return
+        self.follower_leases = {
+            int(b): int(e) for b, e in leases.items()
+            if int(b) != self.controller_broker and b in self.standbys
+        }
 
     def _apply_register_consumer(self, name: str, slot: int) -> None:
         """Idempotent consumer registration. The proposed slot was chosen
@@ -636,6 +686,19 @@ class PartitionManager:
         members AND live."""
         with self.lock:
             return list(self.live)
+
+    def follower_lease(self, broker_id: int) -> Optional[int]:
+        """The epoch this broker's follower-read lease was granted
+        under, or None. Valid only when it equals current_epoch() — the
+        caller re-checks BOTH per answered read (server.py)."""
+        with self.lock:
+            return self.follower_leases.get(int(broker_id))
+
+    def current_follower_leases(self) -> dict[int, int]:
+        """Locked copy of the lease table (metadata advertisement +
+        admin.stats)."""
+        with self.lock:
+            return dict(self.follower_leases)
 
     def get_topics(self) -> list[Topic]:
         with self.lock:

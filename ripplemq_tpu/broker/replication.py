@@ -217,6 +217,18 @@ class _Sender(threading.Thread):
             "sseq": sseq,
             "records": [[t, s, b, p] for t, s, b, p in records],
         }
+        if self._rep.floors_fn is not None and records:
+            # Piggyback the per-slot settled floor (+ gap map) for the
+            # slots this frame touches: the standby publishes it as its
+            # follower-read horizon. Stamped at send time, so it is
+            # conservative — it can only name rounds whose acks already
+            # landed cluster-wide, never this frame's own rows.
+            try:
+                req["floors"] = self._rep.floors_fn(
+                    sorted({r[1] for r in records})
+                )
+            except Exception:
+                pass  # floor stamp is best-effort; the frame still ships
         call_async = getattr(self._rep.client, "call_async", None)
         if call_async is not None:
             return call_async(self._rep.addr_of(self.broker_id), req)
@@ -430,6 +442,7 @@ class RoundReplicator:
         metrics=None,
         sender_id: int = -1,
         pipeline_depth: int = 1,
+        floors_fn: Optional[Callable[[list], list]] = None,
     ) -> None:
         self.client = client
         self.addr_of = addr_of
@@ -438,6 +451,13 @@ class RoundReplicator:
         self.active = active_fn
         self.rpc_timeout_s = rpc_timeout_s
         self.ack_timeout_s = ack_timeout_s
+        # Settled-floor stamp (follower reads): called with the sorted
+        # slot list of each outgoing frame, returns the per-slot
+        # [[slot, floor, gaps], ...] the standby publishes as its local
+        # serve horizon (DataPlane.settle_floors). None → frames carry
+        # no floor and standbys never advance one off this stream —
+        # the wire stays compatible in both directions.
+        self.floors_fn = floors_fn
         # Stream identity + window for the pipelined sender (_Sender.run):
         # (sender_id, epoch) keys the standby's per-stream sequence gate,
         # pipeline_depth bounds the frames in flight per stream.

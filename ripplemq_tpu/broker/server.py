@@ -55,15 +55,18 @@ from ripplemq_tpu.broker.manager import (
     OP_REGISTER_CONSUMER,
     OP_REGISTER_PRODUCER,
     OP_RETIRE_PRODUCER,
+    OP_SET_FOLLOWER_LEASES,
     OP_SET_STANDBYS,
     ConsumerTableFullError,
     PartitionManager,
 )
+from ripplemq_tpu.storage.segment import REC_APPEND
 from ripplemq_tpu.groups.coordinator import GroupLiveness
 from ripplemq_tpu.groups.state import group_consumer_name
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import group_key, topics_to_wire
 from ripplemq_tpu.utils.logs import get_logger
+from ripplemq_tpu.wire import codec
 from ripplemq_tpu.wire.retry import RetryPolicy
 from ripplemq_tpu.wire.transport import (
     InProcNetwork,
@@ -256,6 +259,11 @@ class BrokerServer:
         # _handle_produce. This is the SLO controller's plant output:
         # the p99 it steers toward slo_p99_ack_ms.
         self._m_ack_us = self.metrics.histogram("produce.ack_us")
+        # Consume-ack latency, same contract on the read side (observed
+        # in _handle_consume around the whole answer — leader, follower,
+        # and refusal paths alike): the p99 the SLO controller's consume
+        # twin steers toward slo_p99_consume_ms via read_coalesce_s.
+        self._m_consume_ack_us = self.metrics.histogram("consume.ack_us")
         # Codec stats are process-global: set them symmetrically (last
         # constructed broker wins) rather than latching off forever —
         # a one-way disable would freeze the A/B's obs=True arm when an
@@ -292,6 +300,7 @@ class BrokerServer:
             self._tcp_server = TcpServer(
                 self.info.host, self.info.port, self.dispatch,
                 workers=config.rpc_workers,
+                raw_handler=self._raw_produce,
             )
 
         # --- committed-round store ---
@@ -438,6 +447,25 @@ class BrokerServer:
         # standby side of repl.rounds applies frames in per-stream
         # sequence order while the sender keeps a window in flight.
         self._repl_gate = _ReplStreamGate()
+        # --- follower read plane (broker/follower.py) ---
+        # Serve consumes from the bytes replication already shipped
+        # here: a floor-fenced row cache fed by the repl handlers
+        # (full-copy records + piggybacked floors, or own-stripe frames
+        # decoded on read). Gated per ANSWER on the metadata-plane
+        # lease (manager.follower_lease) — construction is cheap and
+        # unconditional on the knob so ingest starts before the first
+        # lease grant lands.
+        self.follower_plane = None
+        self._follower_cursors: dict[int, list] = {}
+        if config.follower_reads:
+            from ripplemq_tpu.broker.follower import FollowerReadPlane
+
+            self.follower_plane = FollowerReadPlane(
+                config.engine.slot_bytes,
+                config.follower_page_cache_bytes,
+                fetch_fn=(self._fetch_sibling_stripes
+                          if config.replication == "striped" else None),
+            )
         persist_fn = None
         if data_dir is not None:
             import os
@@ -521,6 +549,8 @@ class BrokerServer:
         # TopicsRaftServer.java:216): assignment/controller planning runs
         # at most every membership_poll_s, first pass immediate.
         self._last_membership_poll = 0.0
+        # Follower-lease grant debounce (_follower_lease_duty).
+        self._last_lease_grant = 0.0
         # Repair-scan cadence (see _controller_duty): lag repair needs a
         # device fetch, so it must not ride every duty tick.
         self._last_repair_scan = 0.0
@@ -751,9 +781,25 @@ class BrokerServer:
             from ripplemq_tpu.broker.replication import RoundReplicator
 
             self._replicator = RoundReplicator(
-                self.client, self._addr_of, **kw,
+                self.client, self._addr_of,
+                # Piggyback the per-slot settled floor (+ gap map) on
+                # every repl.rounds frame — the full-copy follower read
+                # plane's serve bound (striped frames already carry the
+                # encoder's gsn floor in their header).
+                floors_fn=self._settle_floors_stamp,
+                **kw,
             )
         return self._replicator
+
+    def _settle_floors_stamp(self, slots):
+        """RoundReplicator.floors_fn: the CURRENT controller plane's
+        per-slot contiguous-settle floors + gap maps (empty when
+        deposed — the frame then ships floor-less and standbys simply
+        don't advance)."""
+        dp = self._local_engine()
+        if dp is None:
+            return []
+        return dp.settle_floors(slots)
 
     def _local_engine(self) -> Optional[DataPlane]:
         """The device program, iff this broker is the CURRENT controller
@@ -872,6 +918,18 @@ class BrokerServer:
                     "ok": True,
                     "topics": topics_to_wire(self.manager.get_topics()),
                     "brokers": [b.to_dict() for b in self.config.brokers],
+                    # Follower-read advertisement: which standbys hold a
+                    # consume lease, and under which controller epoch —
+                    # the client SDK routes explicit-offset consumes to
+                    # a leased broker and falls back to the leader on
+                    # `not_settled_here:` refusals. Empty dict when the
+                    # feature is off or no lease is granted.
+                    "follower_leases": {
+                        str(b): int(e)
+                        for b, e in
+                        self.manager.current_follower_leases().items()
+                    },
+                    "controller_epoch": self.manager.current_epoch(),
                 }
             if t == "meta.propose":
                 return self._handle_meta_propose(req)
@@ -1030,6 +1088,21 @@ class BrokerServer:
         # (`enabled: false` shape when the loop is off — the admission
         # counters still live there, quotas work without the loop).
         stats["slo"] = self.slo.stats()
+        # Follower read plane: lease table + this broker's own serving
+        # counters (floor lag, cache hit rate, reads served/refused).
+        # `enabled: false` shape when the knob is off — the lease keys
+        # are still present so dashboards need no conditional.
+        follower = {
+            "enabled": self.config.follower_reads,
+            "lease_epoch": self.manager.follower_lease(self.broker_id),
+            "leases": {
+                str(b): int(e)
+                for b, e in self.manager.current_follower_leases().items()
+            },
+        }
+        if self.follower_plane is not None:
+            follower.update(self.follower_plane.stats())
+        stats["follower"] = follower
         dp = self._local_engine()
         if dp is None:
             stats["engine"] = None
@@ -1639,7 +1712,41 @@ class BrokerServer:
         finally:
             self._m_ack_us.observe(self.metrics.clock() - t0)
 
-    def _produce_admitted(self, req: dict) -> dict:
+    # Fields the raw-dispatch peek materializes: the routing/admission
+    # scalars plus the message VECTOR's element count (never its bytes).
+    _RAW_PEEK = ("type", "topic", "partition", "producer", "pid", "seq",
+                 "messages")
+
+    def _raw_produce(self, body) -> Optional[dict]:
+        """Raw-frame produce dispatch (TcpServer accept path, host-plane
+        brokers only): peek the routing scalars off the UNDECODED frame
+        and hand the bytes to the owning worker, which performs the
+        frame's single full decode — deleting the per-batch broker
+        decode → ring re-encode → worker decode hop. Returns None for
+        anything that is not a clean host-plane produce; the ordinary
+        decode path then produces the canonical behavior (byte parity
+        between both paths is pinned in tests/test_hostplane.py)."""
+        if self.hostplane is None:
+            return None
+        peek = codec.peek_fields(body, self._RAW_PEEK)
+        if peek is None or peek.get("type") != "produce":
+            return None
+        n = peek.get("messages")
+        if not isinstance(n, int) or n <= 0:
+            return None  # empty/odd batch: canonical path refuses it
+        if not isinstance(peek.get("topic"), str) \
+                or not isinstance(peek.get("partition"), int):
+            return None
+        refusal = self.slo.admit(peek.get("producer"), n)
+        if refusal is not None:
+            return {"ok": False, "error": f"overloaded: {refusal}"}
+        t0 = self.metrics.clock()
+        try:
+            return self._produce_admitted(peek, raw=body, raw_count=n)
+        finally:
+            self._m_ack_us.observe(self.metrics.clock() - t0)
+
+    def _produce_admitted(self, req: dict, raw=None, raw_count: int = 0) -> dict:
         """Produce semantics: at-least-once by default, EXACTLY-ONCE for
         idempotent producers. A batch larger than max_batch is split into
         pipelined rounds, and some rounds can fail while others commit (a
@@ -1668,9 +1775,14 @@ class BrokerServer:
         slot, refusal = self._check_partition(key)
         if refusal:
             return refusal
-        messages = req["messages"]
-        if not isinstance(messages, list) or not messages:
-            return {"ok": False, "error": "bad_request: empty messages"}
+        if raw is None:
+            messages = req["messages"]
+            if not isinstance(messages, list) or not messages:
+                return {"ok": False, "error": "bad_request: empty messages"}
+        else:
+            # Raw dispatch: the batch is still undecoded wire bytes;
+            # only its element count is known (the peek).
+            messages = None
         B = self.config.engine.max_batch
         stamped = None
         if self.hostplane is not None:
@@ -1686,10 +1798,18 @@ class BrokerServer:
             )
 
             try:
-                stamped = self.hostplane.submit(
-                    slot, messages, pid=req.get("pid"), seq=req.get("seq"),
-                    timeout_s=self.config.rpc_timeout_s,
-                )
+                if raw is not None:
+                    stamped = self.hostplane.submit_raw(
+                        slot, raw, raw_count,
+                        pid=req.get("pid"), seq=req.get("seq"),
+                        timeout_s=self.config.rpc_timeout_s,
+                    )
+                else:
+                    stamped = self.hostplane.submit(
+                        slot, messages,
+                        pid=req.get("pid"), seq=req.get("seq"),
+                        timeout_s=self.config.rpc_timeout_s,
+                    )
             except WorkerUnavailableError as e:
                 # Typed RETRYABLE refusal — never a silent hang: the
                 # dispatcher already detected the dead worker and is
@@ -1703,6 +1823,16 @@ class BrokerServer:
                 stamped = None
             except ValueError as e:
                 return {"ok": False, "error": f"bad_request: {e}"}
+        if stamped is None and messages is None:
+            # The raw fast path missed (oversize batch, no worker):
+            # materialize the frame ONCE and run the canonical path —
+            # the fallback contract, identical semantics to the dict
+            # route.
+            full = codec.decode(raw)
+            messages = (full.get("messages")
+                        if isinstance(full, dict) else None)
+            if not isinstance(messages, list) or not messages:
+                return {"ok": False, "error": "bad_request: empty messages"}
         if stamped is not None:
             pid, seq = int(stamped["pid"]), int(stamped["seq"])
             chunk_sizes = [len(lens) for lens, _ in stamped["chunks"]]
@@ -1763,9 +1893,32 @@ class BrokerServer:
         return None
 
     def _handle_consume(self, req: dict) -> dict:
+        """Ack-latency instrumentation around the consume path (the
+        produce.ack_us twin): every answer — leader serve, follower
+        serve, refusal — observes its full wall time into
+        `consume.ack_us`, the p99 the SLO controller's consume twin
+        steers toward slo_p99_consume_ms (via read_coalesce_s)."""
+        t0 = self.metrics.clock()
+        try:
+            return self._consume_checked(req)
+        finally:
+            self._m_consume_ack_us.observe(self.metrics.clock() - t0)
+
+    def _consume_checked(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
         slot, refusal = self._check_partition(key)
         if refusal:
+            # Follower read path: a non-leader with a valid lease may
+            # still answer an explicit-offset consume from its
+            # replicated settled floor (client opt-in via follower_ok;
+            # broker/follower.py for the safety contract). Anything it
+            # cannot prove settled refuses with the retryable
+            # `not_settled_here:` and the client falls back to the
+            # leader named in the ordinary hint.
+            if req.get("follower_ok") and req.get("offset") is not None:
+                answer = self._follower_consume(key, req, refusal)
+                if answer is not None:
+                    return answer
             return refusal
         refusal = self._quorum_refusal(slot)
         if refusal:
@@ -1798,6 +1951,101 @@ class BrokerServer:
         # committable position is next_offset — NOT offset + len(messages).
         return {"ok": True, "messages": msgs, "offset": offset,
                 "next_offset": next_offset}
+
+    def _follower_consume(self, key, req: dict,
+                          not_leader: dict) -> Optional[dict]:
+        """Serve a consume from the follower read plane, or None when
+        this broker is not in a position to even try (feature off, no
+        lease, stale generation) — the caller then answers the ordinary
+        not_leader hint. The lease AND its epoch are re-checked here,
+        per answer: a deposed standby drops to the hint the instant the
+        handover applies, before its plane even resets."""
+        fp = self.follower_plane
+        if fp is None:
+            return None
+        slot = self.manager.slot_of(key)
+        if slot is None:
+            return None
+        epoch = self.manager.current_epoch()
+        if self.manager.follower_lease(self.broker_id) != epoch:
+            return None
+        fp.note_epoch(epoch)  # fence the plane even before new frames
+        if fp.epoch() != epoch:
+            return None  # cached bytes are another generation's
+        offset = int(req["offset"])
+        if offset < 0:
+            return {"ok": False, "error": "bad_request: negative offset"}
+        limit = req.get("max_messages")
+        limit = None if limit is None else int(limit)
+        got = None
+        if self.hostplane is not None:
+            # Shared fan-out on the worker plane: the owning worker's
+            # settled mirror (fed by the repl ingest below) serves the
+            # hot window off this process's GIL — one mirror read feeds
+            # many cursors. Every mirror answer is re-fenced against
+            # the floor/gap map before it leaves (the mirror itself
+            # holds rows ahead of the floor).
+            mirror = self.hostplane.read(slot, offset, limit)
+            if (mirror is not None and mirror[0]
+                    and fp.validate_window(slot, offset, mirror[1])):
+                got = mirror
+        if got is None:
+            got = fp.read(slot, offset, limit)
+        # Last-line witness: EVERY answer (mirror or cache) re-checks
+        # against the floor at the boundary, independent of the serving
+        # path's own fence — a failed audit refuses and is counted as
+        # a first-class chaos violation (answers_past_floor).
+        if got is not None and not fp.audit_answer(slot, offset, got[1]):
+            got = None
+        if got is None:
+            return {
+                "ok": False,
+                "error": f"not_settled_here: slot {slot} offset {offset} "
+                         f"is above this standby's settled floor",
+                "leader": not_leader.get("leader"),
+                "leader_addr": not_leader.get("leader_addr"),
+            }
+        msgs, next_offset = got
+        return {"ok": True, "messages": msgs, "offset": offset,
+                "next_offset": next_offset, "follower": True}
+
+    def _fetch_sibling_stripes(self, min_gsn: int) -> list:
+        """FollowerReadPlane.fetch_fn (striped reconstruct-on-read):
+        one page round over the live stripe holders' `stripe.fetch`,
+        with a persistent forward-only cursor per peer — decode is
+        sequential in gsn, so each call streams the NEXT window of
+        sibling frames instead of rescanning. Returns parsed frames;
+        the plane filters by epoch/gsn."""
+        from ripplemq_tpu.stripes.codec import parse_frame
+
+        holders = set(self.manager.current_stripe_map())
+        live = set(self.manager.live_brokers())
+        out = []
+        for b in sorted(holders):
+            if b == self.broker_id or b not in live:
+                continue
+            cur = self._follower_cursors.get(b)
+            try:
+                resp = self.client.call(
+                    self._addr_of(b),
+                    {"type": "stripe.fetch",
+                     "after": -1 if cur is None else cur,
+                     "min_gsn": int(min_gsn),
+                     "budget": 2 << 20},
+                    timeout=min(2.0, self.config.rpc_timeout_s),
+                )
+            except RpcError:
+                continue
+            if not resp.get("ok"):
+                continue
+            nxt = resp.get("next") or resp.get("last")
+            if nxt is not None:
+                self._follower_cursors[b] = list(nxt)
+            for raw in resp.get("frames") or ():
+                frame = parse_frame(bytes(raw))
+                if frame is not None:
+                    out.append(frame)
+        return out
 
     def _handle_offset_commit(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
@@ -2504,6 +2752,23 @@ class BrokerServer:
                 store.append(*rec)
         if gate_key is not None:
             self._repl_gate.applied(gate_key, sseq)
+        fp = self.follower_plane
+        if fp is not None:
+            # Feed the follower read plane: this frame's rows plus the
+            # leader's piggybacked floor stamp (missing on frames from
+            # pre-floor senders — the plane then holds rows it cannot
+            # yet serve, which is the safe direction).
+            fp.ingest_rounds(epoch, recs, req.get("floors"))
+            if self.hostplane is not None:
+                # Worker-plane fan-out: mirror the replicated rows into
+                # the owning worker so follower reads ride the same
+                # settled-mirror path leader reads do. Mirror answers
+                # are floor-fenced per read (_follower_consume); the
+                # rows themselves are exactly the store's, so a later
+                # promotion of this broker serves them identically.
+                for t, s, b, p in recs:
+                    if t == REC_APPEND:
+                        self._mirror_publish(int(s), int(b), p)
         if self.config.durability == "strict":
             # durability=strict: this ack gates a settled round's
             # producer ack, so the records must be ON DISK before it
@@ -2552,11 +2817,13 @@ class BrokerServer:
         if store is None:
             return {"ok": False, "error": "no_store"}
         recs = []
+        frames = []
         for raw in req["frames"]:
             raw = bytes(raw)
             frame = parse_frame(raw)
             if frame is None:
                 return {"ok": False, "error": "bad_stripe_frame"}
+            frames.append(frame)
             recs.append(
                 (REC_STRIPE, frame.idx, int(frame.gsn) & 0x7FFFFFFF, raw)
             )
@@ -2566,6 +2833,12 @@ class BrokerServer:
         else:
             for rec in recs:
                 store.append(*rec)
+        fp = self.follower_plane
+        if fp is not None:
+            # Feed the follower read plane's own-stripe window + gsn
+            # floor (decode is lazy — reconstruct-on-read).
+            for frame in frames:
+                fp.ingest_stripe(epoch, frame)
         if self.config.durability == "strict":
             store.flush()
             return {"ok": True}
@@ -2611,9 +2884,9 @@ class BrokerServer:
                 if t != REC_STRIPE:
                     continue
                 if isinstance(loc, tuple):
-                    yield [0, int(loc[0]), int(loc[1])], payload
+                    yield [0, int(loc[0]), int(loc[1])], int(_b), payload
                 else:
-                    yield [0, 0, j], payload
+                    yield [0, 0, j], int(_b), payload
             if self._store_dir is not None:
                 import glob as _glob
                 import os as _os
@@ -2634,25 +2907,40 @@ class BrokerServer:
                     try:
                         for t, _s, _b, payload, loc in scan_store_indexed(d):
                             if t == REC_STRIPE:
-                                yield [phase, int(loc[0]),
-                                       int(loc[1])], payload
+                                yield ([phase, int(loc[0]), int(loc[1])],
+                                       int(_b), payload)
                     except Exception:
                         continue  # forensic snapshot rot: best-effort
 
         after = req.get("after", -1)
         after = None if after in (-1, None) else list(after)
-        budget = 32 << 20
+        budget = int(req.get("budget") or (32 << 20))
+        # Optional gsn floor (follower reconstruct-on-read pager): skip
+        # frames below it CHEAPLY off the persisted record's base field
+        # — REC_STRIPE stores `gsn & 0x7FFFFFFF` there, so both sides
+        # compare masked. Skipped frames still advance the served
+        # cursor (`last`), keeping the pager forward-only.
+        min_gsn = req.get("min_gsn")
+        min_gsn = None if min_gsn is None else int(min_gsn) & 0x7FFFFFFF
         frames: list[bytes] = []
         nxt = None
-        for cursor, payload in stripe_records():
+        last = None
+        for cursor, gsn, payload in stripe_records():
             if after is not None and cursor <= after:
+                continue
+            last = cursor
+            if min_gsn is not None and gsn < min_gsn:
                 continue
             frames.append(payload)
             budget -= len(payload)
             if budget <= 0:
                 nxt = cursor
                 break
-        return {"ok": True, "frames": frames, "next": nxt}
+        # `next` keeps its recovery-pager meaning (set only when the
+        # budget clipped the scan); `last` is the cursor of the final
+        # record CONSIDERED, so an incremental pager can resume past
+        # everything already seen even on a short page.
+        return {"ok": True, "frames": frames, "next": nxt, "last": last}
 
     # ---------------------------------------------------------------- duty
 
@@ -2670,6 +2958,7 @@ class BrokerServer:
                 self._controller_duty()
                 self._slot_clean_duty()
                 self._standby_duty()
+                self._follower_lease_duty()
                 self._shard_duty()
             except Exception as e:  # duties must never kill the loop
                 log.warning("broker %d duty error: %s: %s",
@@ -2677,6 +2966,34 @@ class BrokerServer:
                 with self._errors_lock:
                     self.duty_errors.append(f"{type(e).__name__}: {e}")
                     del self.duty_errors[:-20]
+
+    def _follower_lease_duty(self) -> None:
+        """Metadata-leader duty: keep the follower-read lease table
+        equal to {standby: current epoch}. Proposed (not written) — the
+        grant is replicated state, so every broker fences reads against
+        the SAME table, and the OP_SET_CONTROLLER apply clearing it is
+        what revokes a deposed generation everywhere at once."""
+        if not self.config.follower_reads:
+            return
+        if self.runner.node.role != LEADER:
+            return
+        epoch = self.manager.current_epoch()
+        desired = {int(b): epoch for b in self.manager.current_standbys()}
+        if desired == self.manager.current_follower_leases():
+            return
+        now = time.monotonic()
+        if now - self._last_lease_grant < 1.0:
+            return  # debounce: a failed propose retries next tick
+        self._last_lease_grant = now
+        if self.propose_cmd({
+            "op": OP_SET_FOLLOWER_LEASES,
+            "epoch": epoch,
+            "leases": {str(b): int(e) for b, e in desired.items()},
+        }, retries=1):
+            self.recorder.record(
+                "follower_lease", epoch=epoch,
+                brokers=sorted(desired),
+            )
 
     def _metadata_leader_duty(self) -> None:
         node = self.runner.node

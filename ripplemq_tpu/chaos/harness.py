@@ -38,10 +38,12 @@ class _Workload:
     client SDK for the whole run (faulted windows included)."""
 
     def __init__(self, cluster: InProcCluster, seed: int,
-                 history: History, topic: str, partitions: int) -> None:
+                 history: History, topic: str, partitions: int,
+                 follower_reads: bool = False) -> None:
         self.history = history
         self.topic = topic
         self.partitions = partitions
+        self.follower_reads = follower_reads
         self._stop = threading.Event()
         bootstrap = [b.address for b in cluster.config.brokers]
         # Short timeouts + a deadline budget per op: a faulted window
@@ -61,6 +63,7 @@ class _Workload:
             transport=cluster.client(f"chaos-cons-{seed}"),
             metadata_refresh_s=0.3, rpc_timeout_s=1.0,
             retries=3, retry_backoff_s=0.02, deadline_s=3.0,
+            follower_reads=follower_reads,
         )
         self._threads = [
             threading.Thread(target=self._produce_loop, daemon=True,
@@ -129,10 +132,16 @@ class _Workload:
                                     error=f"{type(e).__name__}: {e}")
             else:
                 payloads = [m.decode("utf-8", "replace") for m in msgs]
+                # Tag follower-served reads: the verdict's counts say
+                # how much of the fan-out the standbys absorbed, and a
+                # violating run's history shows WHICH reads a follower
+                # answered.
                 self.history.record(op="consume", client=cid,
                                     topic=self.topic, partition=rpid,
                                     status="ok", offset=off,
-                                    next_offset=nxt, payloads=payloads)
+                                    next_offset=nxt, payloads=payloads,
+                                    follower=bool(
+                                        self.consumer.last_from_follower))
                 if payloads:
                     # auto_commit acked next_offset (consume raises
                     # otherwise), so the commit is part of the history.
@@ -242,6 +251,68 @@ def _collect_slo_stats(cluster) -> dict[str, dict]:
         if st.get("ok") and isinstance(st.get("slo"), dict):
             out[str(bid)] = st["slo"]
     return out
+
+
+def _collect_follower_stats(cluster) -> dict[str, dict]:
+    """One admin.stats `follower` block per reachable broker, over the
+    real transport (both backends) — the serve/refuse counters and the
+    answers_past_floor safety witness live broker-side and survive the
+    post-heal drain."""
+    out: dict[str, dict] = {}
+    client = cluster.client("follower-collect")
+    for bid in cluster.brokers:
+        try:
+            st = client.call(cluster.broker_addr(bid),
+                             {"type": "admin.stats"}, timeout=10.0)
+        except Exception:
+            continue
+        if st.get("ok") and isinstance(st.get("follower"), dict):
+            out[str(bid)] = st["follower"]
+    return out
+
+
+def check_follower(fstats: dict[str, dict],
+                   client_served: int) -> tuple[dict, list[str]]:
+    """The follower-read safety contract, from the brokers' own
+    counters. ONE invariant is first-class, alongside exactly-once: no
+    follower ever ANSWERED a consume above its replicated settled
+    floor (`answers_past_floor`, broker/follower.py audit_answer — the
+    boundary witness every answer passes regardless of which serving
+    path produced it). Serve volume is informational, not an
+    invariant: a gentle schedule whose consumer never falls behind the
+    floor legitimately routes everything to the leader, and the
+    payload-level safety of what followers DID serve is already held
+    by the ordinary checker (follower-served reads are recorded in the
+    same history the exactly-once invariants run over)."""
+    violations: list[str] = []
+    served = refused = past = 0
+    per: dict[str, dict] = {}
+    for bid, s in fstats.items():
+        per[bid] = {k: s.get(k) for k in
+                    ("enabled", "lease_epoch", "mode", "reads_served",
+                     "reads_refused", "rows_served",
+                     "answers_past_floor", "floor_lag_rows")}
+        served += int(s.get("reads_served") or 0)
+        refused += int(s.get("reads_refused") or 0)
+        past += int(s.get("answers_past_floor") or 0)
+    if not fstats:
+        violations.append(
+            "follower: no broker served a follower stats block")
+    elif past:
+        violations.append(
+            f"follower: {past} consume answer(s) reached the serve "
+            f"boundary above the settled floor (answers_past_floor — "
+            f"a serving path's fence failed; the audit refused them, "
+            f"but the fence bug is real)"
+        )
+    section = {
+        "client_reads_served": int(client_served),
+        "broker_reads_served": served,
+        "broker_reads_refused": refused,
+        "answers_past_floor": past,
+        "per_broker": per,
+    }
+    return section, violations
 
 
 def check_slo(slo_stats: dict[str, dict], timeline: list[dict],
@@ -376,6 +447,7 @@ def run_chaos(
     slo_recover_s: float = 45.0,
     slo_shed_bound_s: float = 15.0,
     slo_expect_shed: bool = False,
+    follower_reads: bool = False,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -438,7 +510,20 @@ def run_chaos(
     heal (a post-heal tick meeting the p99 target with shedding off,
     every broker's final mode back to steady). Wall-clock bounds are
     measured honestly; contended tier-1 hosts gate them the same way
-    they gate the convergence probe (tests/helpers.py)."""
+    they gate the convergence probe (tests/helpers.py).
+
+    `follower_reads=True` runs the cluster with the follower-read
+    plane on (EITHER backend, both replication modes) and the workload
+    consumer routing through it (client SDK `follower_reads=True`, so
+    backlogged reads go to leased standbys and refusals fall back to
+    the leader — through every crash, partition and handover the
+    nemesis schedules). The verdict gains a `follower` section and ONE
+    first-class invariant (check_follower): no follower ever ANSWERED
+    above its replicated settled floor, witnessed broker-side at the
+    serve boundary independently of the fences under test
+    (answers_past_floor). Payload safety of follower-served reads
+    needs no extra machinery — they are recorded in the same history
+    the exactly-once checker already runs over."""
     t0 = time.time()
     topic = "chaos"
     tmp = None
@@ -466,6 +551,11 @@ def run_chaos(
             slo_recover_s=float(slo_recover_s),
             slo_chain_depth_max=4,
         )
+    if follower_reads:
+        # Same splat shape as slo: the knob rides the ClusterConfig
+        # into both backends (proc serializes it through the YAML
+        # round-trip like every other field).
+        slo_kw["follower_reads"] = True
     if backend == "proc":
         from ripplemq_tpu.chaos.proc_cluster import (
             ProcCluster,
@@ -511,7 +601,8 @@ def run_chaos(
     verdict: dict = {"seed": seed, "phases": phases,
                      "ops_per_phase": ops_per_phase, "backend": backend,
                      "replication": replication_mode,
-                     "host_workers": host_workers}
+                     "host_workers": host_workers,
+                     "follower_reads": follower_reads}
     try:
         cluster.start()
         cluster.wait_for_leaders()
@@ -526,7 +617,8 @@ def run_chaos(
             if cluster.controller_ready():
                 break
             time.sleep(0.05)
-        workload = _Workload(cluster, seed, history, topic, partitions)
+        workload = _Workload(cluster, seed, history, topic, partitions,
+                             follower_reads=follower_reads)
         workload.start()
         group_workload = None
         if groups > 0:
@@ -651,6 +743,16 @@ def run_chaos(
             )
             verdict["slo"] = slo_section
             violations += slo_violations
+        if follower_reads:
+            # Follower-read safety (tentpole, ISSUE 16): no standby
+            # ever answered above its settled floor — broker-side
+            # boundary witness, first-class alongside exactly-once.
+            f_section, f_violations = check_follower(
+                _collect_follower_stats(cluster),
+                workload.consumer.follower_served,
+            )
+            verdict["follower"] = f_section
+            violations += f_violations
         ops = history.ops()
         # Telemetry collection — while the cluster is still up. Every
         # VIOLATING verdict carries the full diagnosis (per-broker
@@ -700,6 +802,9 @@ def run_chaos(
                 "consume_unknown": sum(1 for o in ops
                                        if o.get("op") == "consume"
                                        and o.get("status") == "unknown"),
+                "consume_follower": sum(1 for o in ops
+                                        if o.get("op") == "consume"
+                                        and o.get("follower")),
                 "delivered": sum(len(o.get("payloads", [])) for o in ops
                                  if o.get("op") == "consume"),
             },

@@ -128,6 +128,8 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "durability": config.durability,
         "replication": config.replication,
         "pid_retention_s": config.pid_retention_s,
+        "follower_reads": config.follower_reads,
+        "follower_page_cache_bytes": config.follower_page_cache_bytes,
         # The batcher operating point and worker sizing used to be
         # dropped here: an in-proc soak and its subprocess twin ran
         # DIFFERENT coalesce/chain/pipeline shapes whenever a test
@@ -148,6 +150,7 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         # point on the subprocess backend as in-proc — the exact drop
         # class the config_plumbing lint exists to prevent).
         "slo_p99_ack_ms": config.slo_p99_ack_ms,
+        "slo_p99_consume_ms": config.slo_p99_consume_ms,
         "slo_tick_s": config.slo_tick_s,
         "slo_recover_s": config.slo_recover_s,
         "slo_read_coalesce_min_s": config.slo_read_coalesce_min_s,

@@ -22,6 +22,20 @@ ASYNCHRONOUSLY (flushed on close()/flush_commits()), so delivery runs
 ahead of the committed offset — a crash between delivery and commit
 flush re-delivers, i.e. prefetch trades the strict at-most-once
 auto-commit for at-least-once pipelining.
+
+Follower reads (`follower_reads=True`, needs a cluster running with the
+broker-side knob on): EXPLICIT-OFFSET reads route to a standby broker
+holding a current-epoch follower-read lease (meta.topics advertises the
+lease table), spreading a backlog fan-out over the standby set instead
+of funneling every cursor through the leader. Safety lives broker-side
+(broker/follower.py: a follower only answers strictly below its
+replicated settled floor, refusing with retryable `not_settled_here:`),
+so the client policy is pure routing: go to a follower only when the
+last window came back FULL (backlog evidence — tail polls would just
+bounce off the floor), fall back to the leader on any refusal, and send
+commits to the leader always. Reads with no tracked position (the first
+call, or after a pipeline break) go to the leader, which owns the
+server-tracked offset table.
 """
 
 from __future__ import annotations
@@ -58,6 +72,7 @@ class ConsumerClient:
         retry_policy: Optional[RetryPolicy] = None,
         prefetch: int = 0,
         long_poll_s: float = 0.0,
+        follower_reads: bool = False,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -67,7 +82,19 @@ class ConsumerClient:
         self.max_messages = max_messages
         self.prefetch = max(0, int(prefetch))
         self.long_poll_s = max(0.0, float(long_poll_s))
+        self.follower_reads = bool(follower_reads)
         self._timeout = rpc_timeout_s
+        # Follower routing's position hint: last delivered next_offset
+        # per (topic, partition). Only a HINT — the leader's
+        # server-tracked offset stays authoritative whenever routing
+        # falls back to it.
+        self._pos: dict[tuple[str, int], int] = {}
+        # Routing forensics: how many deliveries a follower actually
+        # served (vs leader fallback), and whether the LAST one did —
+        # the chaos workload tags its history ops with this so a run's
+        # verdict can say how much fan-out the follower plane absorbed.
+        self.follower_served = 0
+        self.last_from_follower = False
         # Per-(topic, partition) readahead state: the in-flight fetch at
         # an explicit offset, and the newest async auto-commit (kept so
         # errors surface and close() can flush).
@@ -111,6 +138,7 @@ class ConsumerClient:
         STORAGE offsets (the broker pads replication rounds for the TPU's
         alignment), so `offset + len(messages)` is NOT a valid position."""
         limit = self.max_messages if max_messages is None else max_messages
+        self.last_from_follower = False
         call_async = getattr(self._transport, "call_async", None)
         if self.prefetch > 0 and call_async is not None:
             # Pin the round-robin choice ONCE per call: the prefetch
@@ -124,6 +152,16 @@ class ConsumerClient:
                 if t is not None:
                     partition = self._selector.select(t)
             got = self._consume_prefetched(topic, partition, limit, call_async)
+            if got is not None:
+                return got
+        if self.follower_reads:
+            if partition is None:
+                # Same single-selector-advance pinning as the prefetch
+                # probe above (and idempotent with it).
+                t = self._meta.topic(topic)
+                if t is not None:
+                    partition = self._selector.select(t)
+            got = self._consume_follower(topic, partition, limit, call_async)
             if got is not None:
                 return got
         run = self._retry.begin()
@@ -194,35 +232,99 @@ class ConsumerClient:
         msgs = list(resp["messages"])
         offset = st["offset"]
         next_offset = int(resp.get("next_offset", offset))
+        if resp.get("follower"):
+            self.follower_served += 1
+            self.last_from_follower = True
         return self._deliver(topic, pid, st["addr"], limit, call_async,
+                             msgs, offset, next_offset)
+
+    # ---------------------------------------------------- follower reads
+
+    def _consume_follower(self, topic: str, partition: Optional[int],
+                          limit: int, call_async):
+        """One explicit-offset read against a leased follower. Returns
+        None (routing miss, refusal, transport error, or an empty
+        answer) to fall back to the leader path — never an error: the
+        leader serves everything a follower can and more."""
+        if partition is None:
+            return None
+        pid = partition
+        pos = self._pos.get((topic, pid))
+        if pos is None:
+            return None  # no tracked position: the leader resolves it
+        addr = self._meta.follower_addr()
+        if addr is None:
+            return None
+        # Same guard as the sync path: an explicit-offset read must not
+        # race this partition's own unflushed async commit.
+        self._flush_commit_key(topic, pid)
+        try:
+            resp = self._transport.call(
+                addr,
+                {"type": "consume", "topic": topic, "partition": pid,
+                 "consumer": self.consumer_id, "max_messages": limit,
+                 "offset": int(pos), "follower_ok": True},
+                timeout=self._timeout,
+            )
+        except RpcError:
+            return None
+        if not resp.get("ok") or not resp.get("follower"):
+            return None  # not_settled_here / deposed: leader fallback
+        msgs = list(resp["messages"])
+        if not msgs:
+            return None  # gap skip or dry window: let the leader decide
+        offset = int(resp["offset"])
+        next_offset = int(resp.get("next_offset", offset))
+        self.follower_served += 1
+        self.last_from_follower = True
+        return self._deliver(topic, pid, addr, limit, call_async,
                              msgs, offset, next_offset)
 
     def _deliver(self, topic: str, pid: int, addr: str, limit: int,
                  call_async, msgs: list, offset: int, next_offset: int):
         """Common delivery tail: arm the next readahead fetch, run the
-        auto-commit (async when prefetching), return the position tuple."""
+        auto-commit (async when prefetching), return the position tuple.
+        With follower reads on, `addr` may be the follower that just
+        served — commits always re-resolve the LEADER (offset state is
+        a quorum-replicated fact only the leader accepts)."""
+        commit_addr = addr
+        if self.follower_reads:
+            self._pos[(topic, pid)] = int(next_offset)
+            commit_addr = self._meta.leader_addr(topic, pid) or addr
         if self.prefetch > 0 and call_async is not None:
             # Re-arm at next_offset. After an EMPTY window only a
             # long-polling fetch is worth keeping in flight (a plain one
             # would answer empty again immediately; drains break on
             # empty anyway).
             if msgs or self.long_poll_s > 0:
+                wait_s = self.long_poll_s if not msgs else 0.0
                 req = {"type": "consume", "topic": topic, "partition": pid,
                        "consumer": self.consumer_id, "max_messages": limit,
                        "offset": int(next_offset)}
-                wait_s = self.long_poll_s if not msgs else 0.0
                 if wait_s > 0:
                     req["wait_s"] = wait_s
+                fetch_addr = commit_addr
+                # Route the readahead to a leased follower only on
+                # backlog evidence (a FULL window just came back) and
+                # never for a long-poll park — tail reads sit above the
+                # follower's floor by definition and would only bounce.
+                if (self.follower_reads and wait_s == 0.0
+                        and len(msgs) >= limit):
+                    fa = self._meta.follower_addr()
+                    if fa is not None:
+                        fetch_addr = fa
+                        req["follower_ok"] = True
                 try:
-                    fut = call_async(addr, req)
+                    fut = call_async(fetch_addr, req)
                     self._pf[(topic, pid)] = {
                         "offset": int(next_offset), "fut": fut,
-                        "addr": addr, "limit": limit, "wait_s": wait_s,
+                        "addr": fetch_addr, "limit": limit, "wait_s": wait_s,
                     }
                 except RpcError:
                     pass  # connection hiccup: next call goes sync
         if msgs and self.auto_commit:
-            self._auto_commit(topic, pid, next_offset, addr, call_async)
+            self._auto_commit(topic, pid, next_offset, commit_addr,
+                              call_async)
         return msgs, pid, offset, next_offset
 
     def _auto_commit(self, topic: str, pid: int, offset: int, addr: str,

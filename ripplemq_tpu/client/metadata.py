@@ -58,6 +58,12 @@ class MetadataManager:
         self._lock = make_lock("MetadataManager._lock")
         self._topics: dict[str, Topic] = {}
         self._brokers: dict[int, BrokerInfo] = {}
+        # Follower-read routing state (meta.topics carries the lease
+        # table + the controller epoch that scopes it): broker_id →
+        # lease epoch. A lease from another epoch is DEAD — the server
+        # re-checks per answer anyway, this just avoids pointless trips.
+        self._follower_leases: dict[int, int] = {}
+        self._controller_epoch: int = -1
         self._stop = threading.Event()
         self._refresh_interval = refresh_interval_s
         self._thread: Optional[threading.Thread] = None
@@ -108,10 +114,17 @@ class MetadataManager:
                     raise MetadataError(f"{addr}: {resp.get('error')}")
                 topics = topics_from_wire(resp["topics"])
                 brokers = [BrokerInfo.from_dict(b) for b in resp.get("brokers", [])]
+                leases = {
+                    int(b): int(e)
+                    for b, e in dict(resp.get("follower_leases") or {}).items()
+                }
                 with self._lock:
                     self._topics = {t.name: t for t in topics}
                     if brokers:
                         self._brokers = {b.broker_id: b for b in brokers}
+                    self._follower_leases = leases
+                    self._controller_epoch = int(
+                        resp.get("controller_epoch", -1))
                 return
             except (RpcError, MetadataError, KeyError, ValueError) as e:
                 run.note(f"{type(e).__name__}: {e}")
@@ -131,6 +144,27 @@ class MetadataManager:
         with self._lock:
             b = self._brokers.get(broker_id)
             return b.address if b else None
+
+    def follower_leases(self) -> dict[int, int]:
+        """broker_id → lease epoch, CURRENT controller epoch only."""
+        with self._lock:
+            return {b: e for b, e in self._follower_leases.items()
+                    if e == self._controller_epoch}
+
+    def follower_addr(self) -> Optional[str]:
+        """Address of a randomly chosen broker holding a current-epoch
+        follower-read lease (None when none does). Random, not sticky:
+        the whole point of follower reads is spreading N consumers over
+        the standby set."""
+        with self._lock:
+            addrs = [
+                self._brokers[b].address
+                for b, e in self._follower_leases.items()
+                if e == self._controller_epoch and b in self._brokers
+            ]
+        if not addrs:
+            return None
+        return self._rng.choice(addrs)
 
     def leader_addr(self, topic: str, partition_id: int) -> Optional[str]:
         with self._lock:

@@ -219,6 +219,32 @@ class ClusterConfig:
     # degradation and stall streaks engage immediately; see
     # slo/controller.py for the full machine).
     slo_shed_occupancy: float = 0.75
+    # --- Follower reads (broker/follower.py) ----------------------------
+    # Serve consumes from standby brokers out of the bytes the
+    # replication stream already shipped them. When true, the metadata
+    # leader grants every current standby an epoch-stamped follower-read
+    # lease (OP_SET_FOLLOWER_LEASES), each standby maintains a per-slot
+    # contiguous-settle floor from the floors riding its replication
+    # stream, and a leased standby answers explicit-offset consumes
+    # STRICTLY BELOW its local floor from its own replicated copy —
+    # refusing anything above it with the retryable `not_settled_here:`
+    # so clients fall back to the leader. Off by default: the consume
+    # plane stays leader-only (the pre-PR-16 shape). Committed prefixes
+    # and ack semantics are unaffected either way.
+    follower_reads: bool = False
+    # Striped replication only: budget for the follower's decoded-page
+    # cache (reconstructed rounds served to N cursors from one
+    # rs_reconstruct; broker/follower.py). Under full-copy replication
+    # the same budget bounds the retained plaintext rounds. Evicted
+    # pages are re-fetched/re-decoded on demand (striped) or refused to
+    # the leader (full).
+    follower_page_cache_bytes: int = 32 << 20
+    # Consume-side SLO twin of slo_p99_ack_ms: the consume-ack p99
+    # target in MILLISECONDS. > 0 makes the SLO controller AIMD-steer
+    # read_coalesce_s against this target alongside the produce loop
+    # (same rails, same slo_adjust events). 0 (default) leaves consume
+    # latency unmanaged. Requires obs=True when enabled.
+    slo_p99_consume_ms: float = 0.0
     # Per-tenant produce quotas: ((tenant, messages_per_second), ...),
     # tenant = producer-name prefix before the first "/". A quota is a
     # per-broker rate CAP (token bucket, one-second burst) and a
@@ -327,6 +353,26 @@ class ClusterConfig:
                     f"slo_quotas rate for {tenant!r} must be > 0, "
                     f"got {rate!r}"
                 )
+        if self.follower_page_cache_bytes < (1 << 20):
+            raise ValueError(
+                f"follower_page_cache_bytes="
+                f"{self.follower_page_cache_bytes} below the 1 MiB floor: "
+                f"the cache must hold at least one decoded round or every "
+                f"follower read thrashes fetch/reconstruct"
+            )
+        if self.follower_reads and self.standby_count < 1:
+            raise ValueError(
+                "follower_reads requires standby_count >= 1: follower "
+                "reads are served from the standbys' replicated copies "
+                "(with no standbys there is nobody to lease)"
+            )
+        if self.slo_p99_consume_ms < 0:
+            raise ValueError("slo_p99_consume_ms must be >= 0 (0 disables)")
+        if self.slo_p99_consume_ms > 0 and not self.obs:
+            raise ValueError(
+                "slo_p99_consume_ms > 0 requires obs=True: the SLO "
+                "controller reads the live metrics registry"
+            )
         if self.linearizable_reads and self.standby_count < 1:
             # The read barrier proves the controller's epoch through the
             # standby ack stream; with no standbys there is no stream to
@@ -442,12 +488,18 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["replication"] = str(raw["replication"])
     if "pid_retention_s" in raw:
         extra["pid_retention_s"] = float(raw["pid_retention_s"])
+    if "follower_reads" in raw:
+        extra["follower_reads"] = bool(raw["follower_reads"])
+    if "follower_page_cache_bytes" in raw:
+        extra["follower_page_cache_bytes"] = int(
+            raw["follower_page_cache_bytes"])
     # SLO autopilot knobs (float rails + the int chain/window rails +
     # the tenant-quota mapping, normalized to a sorted tuple so the
     # frozen config stays hashable-by-structure and round-trips the
     # proc-cluster serialization byte-stably).
     slo_float_keys = (
-        "slo_p99_ack_ms", "slo_tick_s", "slo_recover_s",
+        "slo_p99_ack_ms", "slo_p99_consume_ms", "slo_tick_s",
+        "slo_recover_s",
         "slo_read_coalesce_min_s", "slo_read_coalesce_max_s",
         "slo_shed_occupancy",
     )

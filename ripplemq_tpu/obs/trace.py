@@ -59,6 +59,9 @@ EVENT_TYPES = frozenset({
     # adjustment (the control timeline postmortems replay) and the
     # load-shedding state machine's transitions.
     "slo_adjust", "slo_shed_on", "slo_shed_off",
+    # Follower reads (broker/server.py): the metadata leader committed
+    # a follower-read lease table for the current controller epoch.
+    "follower_lease",
 })
 
 

@@ -198,10 +198,28 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
                 continue
             m = codec.decode(frame)
             op = m.get("op")
-            if op == "submit":
+            if op in ("submit", "submit_raw"):
                 served += 1
                 out = {"id": m["id"], "ok": True}
-                msgs = m["msgs"]
+                if op == "submit_raw":
+                    # Raw dispatch: the broker peeked only the routing
+                    # scalars off this client frame — THIS decode, on
+                    # the worker's core, is the frame's first and only
+                    # full decode (the deleted hop was broker decode →
+                    # ring re-encode → worker decode).
+                    try:
+                        inner = codec.decode(m["frame"])
+                    except ValueError:
+                        inner = None
+                    msgs = (inner.get("messages")
+                            if isinstance(inner, dict) else None)
+                    if not isinstance(msgs, list):
+                        resp.push(codec.encode(
+                            {"id": m["id"], "ok": False,
+                             "why": "malformed raw produce frame"}))
+                        continue
+                else:
+                    msgs = m["msgs"]
                 bad = None
                 if not msgs:
                     bad = "empty messages"
@@ -403,19 +421,35 @@ class _WorkerHandle:
             if item is None:
                 return
             op, fut = item
+            rid = None
+            parts = None
             if fut is not None:
                 with self._plock:
                     rid = self._next_id
                     self._next_id += 1
                     self._pending[rid] = fut
-                op = dict(op)
-                op["id"] = rid
+                if isinstance(op, tuple):
+                    # Raw-frame request (submit_raw): (meta, blob key,
+                    # undecoded frame). The id rides the meta prefix and
+                    # the frame crosses into shared memory untouched —
+                    # same scatter-gather as post_parts, but round-trip.
+                    meta, bkey, blob = op
+                    parts = [
+                        codec.encode_dict_with_blob(
+                            {**meta, "id": rid}, bkey, blob),
+                        blob,
+                    ]
+                else:
+                    op = dict(op)
+                    op["id"] = rid
             try:
                 if isinstance(op, list):
                     # Pre-split scatter-gather frame (post_parts): the
                     # payload part crosses into shared memory directly,
                     # skipping the encode-buffer re-copy.
                     pushed = self.req_ring.push_parts(op, timeout_s=0)
+                elif parts is not None:
+                    pushed = self.req_ring.push_parts(parts, timeout_s=5.0)
                 else:
                     pushed = self.req_ring.push(
                         codec.encode(op),
@@ -427,7 +461,7 @@ class _WorkerHandle:
                 # path pre-checks sizes, so this is a backstop).
                 if fut is not None:
                     with self._plock:
-                        self._pending.pop(op["id"], None)
+                        self._pending.pop(rid, None)
                     if not fut.done():
                         fut.set_exception(OversizeBatchError(str(e)))
                 continue
@@ -443,7 +477,7 @@ class _WorkerHandle:
                 return
             if not pushed and fut is not None:
                 with self._plock:
-                    self._pending.pop(op["id"], None)
+                    self._pending.pop(rid, None)
                 if not fut.done():
                     fut.set_exception(WorkerUnavailableError(
                         f"host worker {self.idx} ring full"
@@ -638,6 +672,34 @@ class HostPlane:
             op["pid"] = int(pid)
             op["seq"] = int(seq if seq is not None else -1)
         resp = self._handle(slot).request(op, timeout_s)
+        if not resp.get("ok"):
+            raise ValueError(str(resp.get("why", "submit refused")))
+        return resp
+
+    def submit_raw(self, slot: int, frame, n_msgs: int, pid=None, seq=None,
+                   timeout_s: float = 5.0) -> dict:
+        """submit() from an UNDECODED client produce frame: the frame
+        crosses the ring verbatim (scatter-gather, one copy into shared
+        memory) and the owning worker performs its only full decode —
+        the dispatcher contributed a scalar peek, not a decode→re-encode
+        hop. `n_msgs` is the peeked message count (response-size bound);
+        same refusal contract as submit()."""
+        cap = self.ring_bytes // 2
+        k = int(n_msgs)
+        req_bound = len(frame) + 512
+        resp_bound = k * (self.slot_bytes + 16) + 256
+        if req_bound > cap or resp_bound > cap:
+            raise OversizeBatchError(
+                f"{k}-message raw frame needs ~{max(req_bound, resp_bound)} "
+                f"bytes against a {cap}-byte frame cap "
+                f"(host_ring_bytes {self.ring_bytes}); falling back to "
+                f"the in-process submit path"
+            )
+        meta = {"op": "submit_raw", "slot": int(slot)}
+        if pid is not None:
+            meta["pid"] = int(pid)
+            meta["seq"] = int(seq if seq is not None else -1)
+        resp = self._handle(slot).request((meta, "frame", frame), timeout_s)
         if not resp.get("ok"):
             raise ValueError(str(resp.get("why", "submit refused")))
         return resp

@@ -50,11 +50,20 @@ One controller per broker. Every `slo_tick_s` it:
    `slo_shed_on`/`slo_shed_off` and flip the admission controller's
    shed gate (slo/admission.py).
 
+**Consume twin** (`slo_p99_consume_ms`): the same loop measures the
+consume-ack window p99 off `consume.ack_us` and AIMD-steers
+`read_coalesce_s` — the one knob on the consume ack path — against the
+consume target. A consume breach always halves it (latency wins);
+the additive walk-back is suppressed while the PRODUCE loop is in
+breach so the two laws never fight over the shared knob. Either target
+alone starts the control thread; with both set, the produce law runs
+first each tick and the consume law reads the post-adjust knob state.
+
 The clock and the tick driver are injectable: tier-1 tests construct
 the controller without starting the thread and call `tick()` against a
 scripted metrics feed and a fake plane — zero real sleeps. The thread
-only starts when `slo_p99_ack_ms > 0` (config-validated to require the
-metrics registry).
+only starts when `slo_p99_ack_ms > 0` or `slo_p99_consume_ms > 0`
+(either is config-validated to require the metrics registry).
 """
 
 from __future__ import annotations
@@ -111,6 +120,8 @@ class SloController:
                  wall_clock: Callable[[], float] = time.time) -> None:
         self.enabled = float(config.slo_p99_ack_ms) > 0
         self.target_ms = float(config.slo_p99_ack_ms)
+        self.consume_target_ms = float(config.slo_p99_consume_ms)
+        self.consume_enabled = self.consume_target_ms > 0
         self.tick_s = float(config.slo_tick_s)
         self.recover_s = float(config.slo_recover_s)
         self.rc_min = float(config.slo_read_coalesce_min_s)
@@ -138,6 +149,8 @@ class SloController:
         # from ever combining).
         self._hist = metrics.histogram("produce.ack_us")
         self._prev_bins: Optional[list[int]] = None
+        self._consume_hist = metrics.histogram("consume.ack_us")
+        self._prev_consume_bins: Optional[list[int]] = None
         self._lock = make_lock("SloController._lock")
         # --- state under _lock ---
         self._shed = False
@@ -157,6 +170,8 @@ class SloController:
         self._prev_backpressure = 0
         self._last_p99_ms: Optional[float] = None
         self._last_ok: Optional[bool] = None
+        self._last_consume_p99_ms: Optional[float] = None
+        self._last_consume_ok: Optional[bool] = None
         self._last_reasons: list[str] = []
         # [t, p99_ms (-1 = no data), ok (1/0, -1 = no data), shed]
         self._tick_ring: list[list[float]] = []
@@ -170,7 +185,7 @@ class SloController:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        if self.enabled:
+        if self.enabled or self.consume_enabled:
             self._thread.start()
 
     def stop(self) -> None:
@@ -194,15 +209,11 @@ class SloController:
 
     # ------------------------------------------------------------ the loop
 
-    def _window_p99_ms(self) -> tuple[Optional[float], int]:
-        """(p99 of this tick's ack window in ms, sample count) from the
-        cumulative histogram's bin delta. (None, 0) with no data."""
-        bins = getattr(self._hist, "bins", None)
-        if bins is None:
-            return None, 0
-        cur = list(bins)
-        prev = self._prev_bins
-        self._prev_bins = cur
+    @staticmethod
+    def _delta_p99(cur: list[int],
+                   prev: Optional[list[int]]) -> tuple[Optional[float], int]:
+        """(p99 in ms, sample count) of the window between two cumulative
+        log2-bin snapshots. (None, 0) with no data."""
         if prev is None:
             return None, 0
         delta = [max(0, c - p) for c, p in zip(cur, prev)]
@@ -217,6 +228,27 @@ class SloController:
                 return (1 << i) / 1000.0, count
         return (1 << (len(delta) - 1)) / 1000.0, count
 
+    def _window_p99_ms(self) -> tuple[Optional[float], int]:
+        """(p99 of this tick's produce-ack window in ms, sample count)
+        from the cumulative histogram's bin delta. (None, 0) no data."""
+        bins = getattr(self._hist, "bins", None)
+        if bins is None:
+            return None, 0
+        cur = list(bins)
+        prev = self._prev_bins
+        self._prev_bins = cur
+        return self._delta_p99(cur, prev)
+
+    def _consume_window_p99_ms(self) -> tuple[Optional[float], int]:
+        """The consume-side window p99 (the twin of _window_p99_ms)."""
+        bins = getattr(self._consume_hist, "bins", None)
+        if bins is None:
+            return None, 0
+        cur = list(bins)
+        prev = self._prev_consume_bins
+        self._prev_consume_bins = cur
+        return self._delta_p99(cur, prev)
+
     def tick(self) -> dict:
         """One control decision. Returns the tick summary (tests drive
         this directly; the thread discards it)."""
@@ -224,9 +256,13 @@ class SloController:
         dp = self._dataplane_fn()
         with self._lock:  # _prev_bins rides the controller's own mutex
             p99_ms, samples = self._window_p99_ms()
+            c_p99_ms, c_samples = self._consume_window_p99_ms()
         ok: Optional[bool] = None
         if samples >= 1 and p99_ms is not None:
             ok = p99_ms <= self.target_ms
+        c_ok: Optional[bool] = None
+        if c_samples >= 1 and c_p99_ms is not None:
+            c_ok = c_p99_ms <= self.consume_target_ms
         knobs = dp.knob_state() if dp is not None else None
         bp = se = None
         if knobs is not None:
@@ -252,6 +288,8 @@ class SloController:
             self._ticks += 1
             self._last_p99_ms = p99_ms
             self._last_ok = ok
+            self._last_consume_p99_ms = c_p99_ms
+            self._last_consume_ok = c_ok
             for ring, hit in ((self._occ_ev, occ_hit),
                               (self._fail_ev, fail_hit)):
                 ring.append(1 if hit else 0)
@@ -311,8 +349,20 @@ class SloController:
         if dp is not None and knobs is not None and ok is not None \
                 and samples >= MIN_ADJUST_SAMPLES and self.enabled:
             applied = self._adjust(dp, knobs, ok, p99_ms, shed_now)
+        c_applied = None
+        if dp is not None and knobs is not None and c_ok is not None \
+                and c_samples >= MIN_ADJUST_SAMPLES and self.consume_enabled:
+            # Runs after the produce law on purpose: it reads the
+            # POST-adjust knob state, so the shared read_coalesce_s
+            # never takes two conflicting moves in one tick.
+            c_applied = self._adjust_consume(
+                dp, c_ok, c_p99_ms, shed_now,
+                produce_breach=(self.enabled and ok is False))
         return {"t": t, "p99_ms": p99_ms, "samples": samples, "ok": ok,
-                "shed": shed_now, "reasons": reasons, "knobs": applied}
+                "consume_p99_ms": c_p99_ms, "consume_samples": c_samples,
+                "consume_ok": c_ok,
+                "shed": shed_now, "reasons": reasons,
+                "knobs": c_applied if applied is None else applied}
 
     def _adjust(self, dp, knobs: dict, ok: bool, p99_ms: float,
                 shed: bool) -> Optional[dict]:
@@ -343,7 +393,38 @@ class SloController:
         with self._lock:
             self._adjusts += 1
         self._recorder.record(
-            "slo_adjust",
+            "slo_adjust", loop="produce",
+            p99_ms=round(p99_ms, 3), ok=bool(ok), shed=bool(shed),
+            read_coalesce_us=int(applied["read_coalesce_s"] * 1e6),
+            chain_depth=int(applied["chain_depth"]),
+            settle_window=int(applied["settle_window"]),
+        )
+        return applied
+
+    def _adjust_consume(self, dp, ok: bool, p99_ms: float, shed: bool,
+                        produce_breach: bool) -> Optional[dict]:
+        """The consume twin's AIMD law: read_coalesce_s only (the one
+        knob on the consume ack path — chain depth and the settle window
+        shape the PRODUCE pipe). Reads fresh knob state so a same-tick
+        produce adjustment is already visible."""
+        knobs = dp.knob_state()
+        rc = float(knobs["read_coalesce_s"])
+        if not ok:
+            nrc = max(self.rc_min, rc * 0.5)
+        elif p99_ms <= 0.5 * self.consume_target_ms and not produce_breach:
+            # Walk back toward throughput only when the produce loop is
+            # not mid-breach: the knob is shared, and re-raising it the
+            # same tick the produce law halved it would oscillate.
+            nrc = min(self.rc_max, rc + self.rc_step)
+        else:
+            return None
+        if abs(nrc - rc) < 1e-9:
+            return None
+        applied = dp.set_knobs(read_coalesce_s=nrc)
+        with self._lock:
+            self._adjusts += 1
+        self._recorder.record(
+            "slo_adjust", loop="consume",
             p99_ms=round(p99_ms, 3), ok=bool(ok), shed=bool(shed),
             read_coalesce_us=int(applied["read_coalesce_s"] * 1e6),
             chain_depth=int(applied["chain_depth"]),
@@ -362,11 +443,15 @@ class SloController:
         with self._lock:
             return {
                 "enabled": self.enabled,
-                "mode": ("off" if not self.enabled
+                "mode": ("off" if not (self.enabled or self.consume_enabled)
                          else "shed" if self._shed else "steady"),
                 "target_p99_ms": self.target_ms,
                 "p99_ms": self._last_p99_ms,
                 "meeting_slo": self._last_ok,
+                "consume_enabled": self.consume_enabled,
+                "target_p99_consume_ms": self.consume_target_ms,
+                "consume_p99_ms": self._last_consume_p99_ms,
+                "consume_meeting_slo": self._last_consume_ok,
                 "ticks": self._ticks,
                 "adjustments": self._adjusts,
                 "shed_count": self._shed_count,

@@ -328,6 +328,92 @@ def _decode_at(buf: memoryview, pos: int):
     raise ValueError(f"bad tag byte {tag!r} at {pos - 1}")
 
 
+def _skip_at(buf: memoryview, pos: int) -> int:
+    """Advance past one encoded value WITHOUT materializing it — the
+    raw-dispatch peek's workhorse (a packed message vector is skipped
+    by its length table alone; no per-element bytes() copies)."""
+    tag = bytes(buf[pos : pos + 1])
+    pos += 1
+    if tag in (_NONE, _TRUE, _FALSE):
+        return pos
+    if tag == _INT:
+        _, pos = _read_varint(buf, pos)
+        return pos
+    if tag == _FLOAT:
+        return pos + 8
+    if tag in (_STR, _BYTES):
+        n, pos = _read_length(buf, pos)
+        return pos + n
+    if tag == _VEC:
+        n, pos = _read_length(buf, pos)
+        if 4 * n > len(buf) - pos:
+            raise ValueError(f"vector table of {n} at {pos} exceeds buffer")
+        lens = struct.unpack_from(f"<{n}I", buf, pos)
+        pos += 4 * n
+        total = sum(lens)
+        if total > len(buf) - pos:
+            raise ValueError(f"vector blob at {pos} exceeds remaining buffer")
+        return pos + total
+    if tag == _LIST:
+        n, pos = _read_length(buf, pos)
+        for _ in range(n):
+            pos = _skip_at(buf, pos)
+        return pos
+    if tag == _DICT:
+        n, pos = _read_length(buf, pos)
+        for _ in range(n):
+            klen, pos = _read_length(buf, pos)
+            pos += klen
+            pos = _skip_at(buf, pos)
+        return pos
+    raise ValueError(f"bad tag byte {tag!r} at {pos - 1}")
+
+
+def peek_fields(raw, want) -> "dict | None":
+    """Decode ONLY the requested top-level fields of an encoded dict,
+    structurally skipping everything else (no payload materialization).
+
+    The raw-frame dispatch peek (broker/server.py _raw_produce): the
+    accept path needs the routing scalars — type, topic, partition, the
+    idempotence pid/seq — to route an undecoded produce frame to its
+    owning host worker, which then performs the frame's single full
+    decode. Requested fields that hold a packed vector or list decode
+    to their ELEMENT COUNT (int), bytes values to their byte length —
+    enough for admission/size checks without touching the blob.
+
+    Returns None for anything that is not a well-formed encoded dict:
+    the caller falls back to the ordinary decode path, which produces
+    the canonical error."""
+    buf = memoryview(raw)
+    try:
+        if bytes(buf[0:1]) != _DICT:
+            return None
+        n, pos = _read_length(buf, 1)
+        out: dict = {}
+        for _ in range(n):
+            klen, pos = _read_length(buf, pos)
+            k = str(buf[pos : pos + klen], "utf-8")
+            pos += klen
+            if k in want:
+                tag = bytes(buf[pos : pos + 1])
+                if tag in (_VEC, _LIST):
+                    out[k], _ = _read_length(buf, pos + 1)
+                    pos = _skip_at(buf, pos)
+                elif tag == _BYTES:
+                    ln, p2 = _read_length(buf, pos + 1)
+                    out[k] = ln
+                    pos = p2 + ln
+                else:
+                    out[k], pos = _decode_at(buf, pos)
+            else:
+                pos = _skip_at(buf, pos)
+        if pos != len(buf):
+            return None
+        return out
+    except (ValueError, IndexError, struct.error, UnicodeDecodeError):
+        return None
+
+
 def decode(raw: bytes | memoryview):
     stats = _STATS_ENABLED
     t0 = time.perf_counter_ns() if stats else 0

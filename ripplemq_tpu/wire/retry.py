@@ -109,6 +109,14 @@ RETRYABLE_ERROR_PREFIXES = (
     # drop acked-workload retries on the floor, a bare retry storm
     # would defeat the shed).
     "overloaded",
+    # Follower-read refusal (broker/follower.py): the offset is above
+    # this standby's replicated settled floor (or its lease/cache can't
+    # cover it right now). The row exists — the LEADER serves it — so
+    # the client's routing layer falls back to the leader and retries
+    # there; the floor on this standby also advances with replication,
+    # so "later" genuinely heals it. Never fatal: refusing instead of
+    # serving is exactly the safety contract.
+    "not_settled_here",
     "internal",             # unexpected exception; timing-dependent
 )
 

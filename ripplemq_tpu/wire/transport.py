@@ -250,8 +250,16 @@ class TcpServer:
         port: int,
         handler: Handler,
         workers: int = 16,
+        raw_handler: Optional[Callable[[bytes], Optional[dict]]] = None,
     ) -> None:
         self._handler = handler
+        # Raw-frame dispatch hook: sees the UNDECODED body before the
+        # codec runs and may answer the request itself (the broker's
+        # produce fast path peeks routing scalars and ships the frame
+        # to the owning host worker, which performs the only decode).
+        # Returning None falls through to the ordinary decode path —
+        # the hook must never raise for "not mine".
+        self._raw_handler = raw_handler
         self._sock = socket.create_server((host, port), reuse_port=False)
         self._sock.settimeout(0.2)
         self.host, self.port = self._sock.getsockname()[:2]
@@ -302,10 +310,13 @@ class TcpServer:
 
     def _handle_one(self, conn, write_lock, req_id: int, body: bytes) -> None:
         try:
-            request = codec.decode(body)
-            if not isinstance(request, dict):
-                raise ValueError("request must be a dict")
-            resp = self._handler(request)
+            resp = (self._raw_handler(body)
+                    if self._raw_handler is not None else None)
+            if resp is None:
+                request = codec.decode(body)
+                if not isinstance(request, dict):
+                    raise ValueError("request must be a dict")
+                resp = self._handler(request)
         except Exception as e:
             resp = {"ok": False, "error": f"internal: {type(e).__name__}: {e}"}
         try:

@@ -232,3 +232,64 @@ def test_partition_group_map_is_disjoint_and_total():
     owners = [worker_of(s, 4) for s in range(128)]
     assert set(owners) == {0, 1, 2, 3}
     assert all(worker_of(s, 4) == s % 4 for s in range(128))
+
+
+def test_raw_frame_dispatch_byte_parity_with_dict_path():
+    """Raw-frame dispatcher (ISSUE 16 satellite): a produce frame
+    routed UNDECODED off its peeked header scalars — the TcpServer
+    accept path's hook — commits byte-identically to the same request
+    through the ordinary decode path, and anything the peek cannot
+    cleanly classify falls back (None) to that path."""
+    import dataclasses
+
+    from ripplemq_tpu.wire.codec import encode
+    from tests.broker_harness import InProcCluster, make_config
+
+    cfg = dataclasses.replace(make_config(3), host_workers=2)
+    with InProcCluster(cfg) as c:
+        c.wait_for_leaders()
+        client = c.client()
+        payloads = [b"raw-%d" % i for i in range(6)]
+        mgr = next(iter(c.brokers.values())).manager
+        lead0 = c.brokers[mgr.leader_of(("topic1", 0))]
+        lead1 = c.brokers[mgr.leader_of(("topic1", 1))]
+
+        def until_ok(fn, deadline_s=20.0):
+            t0 = time.monotonic()
+            while True:
+                resp = fn()
+                if resp is not None and resp.get("ok"):
+                    return resp
+                if time.monotonic() - t0 > deadline_s:
+                    pytest.fail(f"no ok before deadline: {resp}")
+                time.sleep(0.1)  # worker subprocesses still booting
+
+        # Partition 0 through the ordinary dict path.
+        until_ok(lambda: client.call(lead0.addr, {
+            "type": "produce", "topic": "topic1", "partition": 0,
+            "messages": payloads}))
+        # Partition 1 through the raw dispatcher, same bytes.
+        raw = encode({"type": "produce", "topic": "topic1",
+                      "partition": 1, "messages": payloads})
+        until_ok(lambda: lead1._raw_produce(raw))
+
+        def drain(lead, p):
+            msgs, offset = [], 0
+            while True:
+                r = client.call(lead.addr, {
+                    "type": "consume", "topic": "topic1", "partition": p,
+                    "consumer": f"raw-drain-{p}", "offset": offset})
+                assert r.get("ok"), r
+                if not r["messages"]:
+                    return msgs
+                msgs += r["messages"]
+                offset = r["next_offset"]
+
+        assert drain(lead0, 0) == drain(lead1, 1) == payloads
+        # Fallback contract: non-produce, junk, and empty batches all
+        # decline so the canonical path answers.
+        assert lead1._raw_produce(encode({"type": "consume"})) is None
+        assert lead1._raw_produce(b"\x00junk") is None
+        assert lead1._raw_produce(encode({
+            "type": "produce", "topic": "topic1", "partition": 1,
+            "messages": []})) is None

@@ -467,6 +467,10 @@ def test_config_validation():
         ClusterConfig(**base, slo_quotas=(("", 5.0),))
     with pytest.raises(ValueError):
         ClusterConfig(**base, slo_quotas=(("t", 0.0),))
+    with pytest.raises(ValueError):
+        ClusterConfig(**base, slo_p99_consume_ms=10.0, obs=False)
+    with pytest.raises(ValueError):
+        ClusterConfig(**base, slo_p99_consume_ms=-1.0)
     ok = ClusterConfig(**base, slo_p99_ack_ms=10.0,
                        slo_quotas=(("t", 5.0),))
     assert ok.slo_recover_s > 0
@@ -499,3 +503,99 @@ def test_dataplane_set_knobs_live():
         assert dp.submit_append(0, [b"post"]).result(timeout=20) is not None
     finally:
         dp.stop()
+
+
+# --------------------------------------------------- consume twin (ISSUE 16)
+
+
+def feed_consume(metrics: Metrics, p99_ms: float, n: int = 8) -> None:
+    """The consume-side feed twin: observe the consume-ack window the
+    broker's _handle_consume instrumentation fills."""
+    h = metrics.histogram("consume.ack_us")
+    h.observe_int(int(p99_ms * 1000))
+    for _ in range(n - 1):
+        h.observe_int(int(p99_ms * 1000) - 1)
+
+
+def _prime(ctl, clock):
+    """First tick only establishes the cumulative-bin baseline (the
+    window p99 is a delta between snapshots); adjustments start on the
+    second."""
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+
+
+def test_consume_twin_halves_coalesce_on_breach():
+    plane = FakePlane()
+    cfg = slo_config(slo_p99_ack_ms=0.0, slo_p99_consume_ms=10.0)
+    ctl, metrics, recorder, clock, _ = make_controller(cfg, plane)
+    # The consume target alone runs the loop (produce law dormant).
+    assert not ctl.enabled and ctl.consume_enabled
+    _prime(ctl, clock)
+    feed_consume(metrics, 40.0)
+    clock.advance(ctl.tick_s)
+    out = ctl.tick()
+    assert out["consume_ok"] is False
+    assert plane.read_coalesce_s == pytest.approx(0.002)
+    feed_consume(metrics, 40.0)
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    # Multiplicative decrease rides down to the rail, never below.
+    assert plane.read_coalesce_s == pytest.approx(0.001)
+    evs = [e for e in recorder.snapshot() if e["type"] == "slo_adjust"]
+    assert evs and all(e["loop"] == "consume" for e in evs)
+
+
+def test_consume_twin_walks_back_only_with_real_margin():
+    plane = FakePlane()
+    plane.read_coalesce_s = 0.001
+    cfg = slo_config(slo_p99_ack_ms=0.0, slo_p99_consume_ms=10.0)
+    ctl, metrics, recorder, clock, _ = make_controller(cfg, plane)
+    _prime(ctl, clock)
+    feed_consume(metrics, 2.0)  # comfortably under half the target
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    assert plane.read_coalesce_s > 0.001
+    # Merely meeting the target is equilibrium, not headroom: a p99 in
+    # (target/2, target] holds the knob still.
+    rc = plane.read_coalesce_s
+    feed_consume(metrics, 6.0)  # log2 bins read this as ~8.2 ms
+    clock.advance(ctl.tick_s)
+    out = ctl.tick()
+    assert out["consume_ok"] is True
+    assert plane.read_coalesce_s == pytest.approx(rc)
+
+
+def test_consume_increase_suppressed_during_produce_breach():
+    """The knob is shared: the tick the produce law halves
+    read_coalesce_s, a comfortable consume window must not re-raise it
+    (oscillation), even though its own law says increase."""
+    plane = FakePlane()
+    cfg = slo_config(slo_p99_ack_ms=20.0, slo_p99_consume_ms=10.0)
+    ctl, metrics, recorder, clock, _ = make_controller(cfg, plane)
+    _prime(ctl, clock)
+    feed(metrics, 80.0)          # produce deep in breach
+    feed_consume(metrics, 2.0)   # consume comfortable
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    assert plane.read_coalesce_s == pytest.approx(0.002)
+    evs = [e for e in recorder.snapshot() if e["type"] == "slo_adjust"]
+    assert evs and all(e["loop"] == "produce" for e in evs)
+
+
+def test_consume_twin_stats_surface():
+    plane = FakePlane()
+    cfg = slo_config(slo_p99_ack_ms=0.0, slo_p99_consume_ms=10.0)
+    ctl, metrics, recorder, clock, _ = make_controller(cfg, plane)
+    st = ctl.stats()
+    assert st["consume_enabled"] is True
+    assert st["target_p99_consume_ms"] == pytest.approx(10.0)
+    assert st["mode"] != "off"
+    _prime(ctl, clock)
+    feed_consume(metrics, 4.0)
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    st = ctl.stats()
+    assert st["consume_p99_ms"] is not None
+    assert st["consume_p99_ms"] <= 10.0
+    assert st["consume_meeting_slo"] is True
