@@ -1751,6 +1751,132 @@ def _run_slo_convergence(target_ms: float = 25.0, light_s: float = 1.5,
         }
 
 
+def _run_split_rebalance(warm_s: float = 1.5, tail_s: float = 1.5,
+                         bucket_s: float = 0.25) -> dict:
+    """Elastic-partition rebalance cost (ISSUE 17): a 3-broker in-proc
+    cluster under sustained KEYED produce load splits its hottest
+    partition online, and the phase reports the time-to-rebalance (the
+    begin→cutover interval from the brokers' own flight recorders plus
+    the wall-clock until every assignment is active again) and the
+    throughput dip (worst ack-rate bucket touching the handoff window
+    vs the pre-split average). Count-exact: every acked produce must be
+    read back from the final logs — a lost write fails the phase, it
+    does not average away."""
+    import threading as _threading
+    import time as _time
+
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.chaos.harness import _drain_partition
+    from ripplemq_tpu.client import ProducerClient
+    from ripplemq_tpu.metadata.models import Topic
+
+    topic = "splitbench"
+    config = make_cluster_config(
+        3, topics=(Topic(topic, 2, 3),), spare_slots=1,
+        split_handoff_timeout_s=5.0,
+    )
+    with InProcCluster(config) as cluster:
+        cluster.wait_for_leaders()
+        bootstrap = [b.address for b in config.brokers]
+        producer = ProducerClient(
+            bootstrap, transport=cluster.client("splitbench-p"),
+            metadata_refresh_s=0.2, rpc_timeout_s=5.0,
+        )
+        acks: list[float] = []          # ack wall-clock stamps
+        stop = _threading.Event()
+
+        def offered() -> None:
+            i = 0
+            while not stop.is_set():
+                try:
+                    producer.produce(topic, f"sb:{i}".encode(),
+                                     key=f"k{i % 64:02d}".encode())
+                except Exception:
+                    continue  # refusals/reroutes retry as new payloads
+                acks.append(_time.time())
+                i += 1
+
+        t = _threading.Thread(target=offered, daemon=True)
+        t.start()
+        try:
+            _time.sleep(warm_s)
+            t_split = _time.time()
+            resp = cluster.admin_split(topic, 0)
+            # Wall-clock until the routing table is fully active again.
+            rebalanced_at = None
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline:
+                view = cluster.topic_view(topic)
+                if view and all(a.state == "active" for a in view):
+                    rebalanced_at = _time.time()
+                    break
+                _time.sleep(0.01)
+            _time.sleep(tail_s)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            producer.close()
+        n_acked = len(acks)
+        # Count-exact readback over EVERY partition (child included).
+        pids = sorted(a.partition_id for a in cluster.topic_view(topic))
+        readback = sum(
+            len(_drain_partition(cluster, topic, pid, tag=f"sb-{pid}"))
+            for pid in pids
+        )
+        # Broker-side witnesses: begin→cutover interval + counters.
+        admin = cluster.client("splitbench-a")
+        cut_s = None
+        forwarded = fences = 0
+        for b in config.brokers:
+            try:
+                st = admin.call(b.address, {"type": "admin.stats"},
+                                timeout=10.0)
+                tr = admin.call(b.address, {"type": "admin.trace"},
+                                timeout=10.0)
+            except Exception:
+                continue
+            rc = st.get("reconfig") or {}
+            forwarded += int(rc.get("forwarded_writes") or 0)
+            fences += int(rc.get("fence_refusals") or 0)
+            evs = {e["type"]: e["t"] for e in tr.get("trace", [])
+                   if e.get("type") in ("split_begin", "split_cutover")}
+            if "split_begin" in evs and "split_cutover" in evs:
+                d = evs["split_cutover"] - evs["split_begin"]
+                if d >= 0 and (cut_s is None or d < cut_s):
+                    cut_s = round(d, 3)
+        # Throughput: pre-split average vs the worst bucket in the
+        # post-split window of the same length.
+        pre = [a for a in acks if a < t_split]
+        pre_rate = round(len(pre) / max(warm_s, 1e-6), 1)
+        buckets: dict[int, int] = {}
+        for a in acks:
+            if a >= t_split:
+                buckets[int((a - t_split) / bucket_s)] = (
+                    buckets.get(int((a - t_split) / bucket_s), 0) + 1)
+        n_buckets = max(1, int(tail_s / bucket_s))
+        worst = min((buckets.get(i, 0) for i in range(n_buckets)),
+                    default=0) / bucket_s
+        if readback != n_acked:
+            raise AssertionError(
+                f"split_rebalance readback mismatch: acked {n_acked}, "
+                f"read back {readback} (partitions {pids})"
+            )
+        return {
+            "split_ok": bool(resp.get("ok")),
+            "time_to_rebalance_s": (
+                None if rebalanced_at is None
+                else round(rebalanced_at - t_split, 3)),
+            "begin_to_cutover_s": cut_s,
+            "pre_split_acks_per_sec": pre_rate,
+            "worst_post_split_bucket_acks_per_sec": round(worst, 1),
+            "dip_ratio": (round(worst / pre_rate, 3) if pre_rate else None),
+            "forwarded_writes": forwarded,
+            "fence_refusals": fences,
+            "acked": n_acked,
+            "readback": readback,
+        }
+
+
 def _run_stripe_encode(mb: int = 4, reps: int = 3) -> float:
     """stripe_encode_mb_per_sec: GF(2⁸) RS(3,2) group-encode throughput
     at the sender's group-commit blob shape (one gf_matmul per blob —
@@ -2027,6 +2153,9 @@ def main() -> None:
     group_consume = _run_group_consume()
     # ISSUE 13: SLO autopilot time-to-SLO after a step-load change.
     slo_convergence = _run_slo_convergence()
+    # ISSUE 17: online split under sustained keyed load — time-to-
+    # rebalance + throughput dip, count-exact readback.
+    split_rebalance = _run_split_rebalance()
     # ISSUE 16: fan-out consume A/B — follower reads OFF vs ON over
     # subprocess brokers, consumer-count sweep, count-exact per arm.
     consume_fanout = _run_consume_fanout()
@@ -2064,6 +2193,7 @@ def main() -> None:
                 "readback": "verified",
                 "host_plane_scaling": host_plane_scaling,
                 "slo_convergence": slo_convergence,
+                "split_rebalance": split_rebalance,
                 "consume_fanout": consume_fanout,
                 **group_consume,
                 **e2e,
@@ -2083,5 +2213,9 @@ if __name__ == "__main__":
         # process never touches jax) — runnable without the full bench:
         #     python bench.py consume_fanout
         print(json.dumps({"consume_fanout": _run_consume_fanout()}))
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "split_rebalance":
+        # Standalone elastic-split rebalance phase:
+        #     python bench.py split_rebalance
+        print(json.dumps({"split_rebalance": _run_split_rebalance()}))
     else:
         main()

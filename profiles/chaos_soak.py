@@ -105,6 +105,16 @@ def main() -> int:
                          "replicated settled floor is a first-class "
                          "violation; works on both backends and both "
                          "replication modes")
+    ap.add_argument("--splits", type=int, default=0,
+                    help="provision N spare engine slots and run the "
+                         "cluster ELASTIC: the nemesis pool gains online "
+                         "split_partition/merge_partitions ops (raced "
+                         "against crashes and controller failover), the "
+                         "producer workload goes keyed through the "
+                         "generation-fenced routing, and the verdict "
+                         "gains a `reconfig` section whose bounded "
+                         "time-to-rebalance invariants are first-class "
+                         "violations; works on both backends")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON file holding a recorded trace (or a full "
                          "verdict) to re-apply instead of generating "
@@ -134,6 +144,10 @@ def main() -> int:
             args.backend = doc["backend"]
         if isinstance(doc, dict) and doc.get("replication"):
             args.replication = doc["replication"]  # same rationale
+        if isinstance(doc, dict) and doc.get("splits"):
+            # Elastic traces carry split/merge ops whose candidate
+            # resolution needs the spare slots the recording ran with.
+            args.splits = int(doc["splits"])
         n_phases = 1 + max((t.get("phase", 0) for t in trace), default=0)
         schedule = [[] for _ in range(n_phases)]
         for t in trace:
@@ -162,6 +176,7 @@ def main() -> int:
             host_workers=args.host_workers,
             slo=args.slo,
             follower_reads=args.follower_reads,
+            splits=args.splits,
             # Process boots (JAX import + XLA compiles per broker) put
             # convergence probes on a different clock than in-proc runs.
             converge_timeout_s=120.0 if args.backend == "proc" else 30.0,

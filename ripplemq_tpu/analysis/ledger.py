@@ -54,6 +54,19 @@ WAIVERS: tuple[Waiver, ...] = (
     # -- ownership --------------------------------------------------------
     Waiver(
         rule="ownership",
+        key="ripplemq_tpu/broker/server.py::BrokerServer::_promoted_live",
+        reason=(
+            "Monotone latch (False -> True, never cleared): the raft "
+            "apply thread sets it on a witnessed live promotion, the "
+            "duty thread sets it when adopting a recovered claim with "
+            "no standby to abdicate to. Both writers store the same "
+            "value; a racing read that misses the latch costs at most "
+            "one extra abdication check next duty tick, never an "
+            "incorrect boot (the duty re-reads every pass)."
+        ),
+    ),
+    Waiver(
+        rule="ownership",
         key="ripplemq_tpu/broker/dataplane.py::DataPlane::_host_ring",
         reason=(
             "Deliberate single-writer design: _mirror_records is the "

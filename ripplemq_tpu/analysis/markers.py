@@ -71,6 +71,8 @@ FAST_MODULES = {
     "test_settled_gap",
     "test_slo",                 # fake-clock control-loop units
     "test_slo_chaos",           # ~20 s: one 3-broker slo chaos smoke
+    "test_split",               # ~15 s: split/merge units + one e2e cluster
+    "test_split_chaos",         # ~45 s: elastic chaos smokes (1 proc)
     "test_term_skew",
     "test_repl_pipeline",       # ~6 s: stub-client sender window units
     "test_retention",

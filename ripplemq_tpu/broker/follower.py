@@ -531,6 +531,28 @@ class FollowerReadPlane:
             self._publish_locked(slot, base, bytes(payload))
             self._floor[slot] = base + nrows
 
+    def prune_slots(self, valid) -> int:
+        """Drop serve state for engine slots the metadata plane no
+        longer maps (a topic table replace that deleted or renumbered
+        a partition): a dangling floor/gap/run entry would otherwise
+        survive until the next controller handover resets the whole
+        plane — and a slot REUSED by a later topic table would inherit
+        the dead partition's floor as its own. Called from the broker's
+        duty loop with the manager's current slot set; returns how many
+        slots were pruned. Stale `_order` FIFO entries for pruned runs
+        are harmless — eviction already skips missing runs."""
+        valid = {int(s) for s in valid}
+        with self._lock:
+            stale = (set(self._floor) | set(self._gaps)
+                     | set(self._runs)) - valid
+            for s in stale:
+                self._floor.pop(s, None)
+                self._gaps.pop(s, None)
+                run = self._runs.pop(s, None)
+                if run is not None:
+                    self._nbytes -= run.nbytes
+            return len(stale)
+
     # ----------------------------------------------------------- stats
 
     def floors(self) -> dict[int, int]:

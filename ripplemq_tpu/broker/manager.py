@@ -43,6 +43,7 @@ from ripplemq_tpu.groups.state import group_consumer_name
 from ripplemq_tpu.metadata.assigner import assign_partitions
 from ripplemq_tpu.metadata.cluster_config import ClusterConfig
 from ripplemq_tpu.metadata.models import (
+    RANGE_SPACE,
     GroupKey,
     PartitionAssignment,
     Topic,
@@ -112,6 +113,27 @@ OP_SET_STANDBYS = "set_standbys"
 # map. Brokers re-check the lease per answered read (server.py), so
 # revocation is one metadata round, not a timeout.
 OP_SET_FOLLOWER_LEASES = "set_follower_leases"
+# Elastic partitions (online split/merge). OP_SPLIT_PARTITION carves a
+# parent's key-hash range at its midpoint into a child partition placed
+# on a SPARE engine slot (the engine's [P, R] shape is fixed at boot, so
+# elasticity spends pre-provisioned slots: `engine.partitions` beyond
+# the configured topic total; with no spare slot the apply is a
+# deterministic no-op). The split bumps the parent's generation, opens
+# the HANDOFF window (the parent's leader dual-writes migrated-range
+# traffic into the child's slot), and revokes every follower-read lease
+# (the handover fence discipline reapplied — the lease duty re-grants
+# once the child's floor is live). OP_SPLIT_CUTOVER closes the window:
+# proposed by the controller only once the parent's settled floor has
+# reached the watermark recorded at split begin (no write acked before
+# the split can be lost to a post-cutover failover) — both generations
+# bump again so any still-handoff-stamped client re-resolves.
+# OP_MERGE_PARTITIONS reabsorbs an adjacent split child's range into
+# its parent and RETIRES the child: produces draw the typed
+# `stale_partition_gen:` refusal with routing to the parent, while the
+# child's log stays readable for consumers draining it.
+OP_SPLIT_PARTITION = "split_partition"
+OP_SPLIT_CUTOVER = "split_cutover"
+OP_MERGE_PARTITIONS = "merge_partitions"
 # N commands applied atomically as ONE hostraft entry. Exists because a
 # thousand-partition election wave must not pay a thousand per-entry
 # proposal/broadcast costs: the controller advertises every winner of a
@@ -186,6 +208,16 @@ class PartitionManager:
         # matching the CURRENT epoch authorize serving; the table is
         # cleared on every controller handover.
         self.follower_leases: dict[int, int] = {}
+        # Elastic partitions: dynamic (topic, pid) → engine-slot
+        # extension for split children (replicated — assigned inside
+        # the split apply from the spare-slot pool, so every broker
+        # routes a child identically), and the open handoff windows:
+        # (topic, parent_pid) → {"child": pid, "watermark": parent log
+        # end the proposer observed at split begin}. Replicated so a
+        # controller that fails over mid-handoff still finishes the
+        # cutover.
+        self.dyn_slots: dict[GroupKey, int] = {}
+        self.handoffs: dict[GroupKey, dict] = {}
         # Election debounce: slot → when it was first seen leaderless.
         # A partition must stay leaderless for config.election_timeout_s
         # before the controller ballots it (the role JRaft's per-group
@@ -257,6 +289,20 @@ class PartitionManager:
                 int(cmd["epoch"]),
                 {int(b): int(e) for b, e in dict(cmd["leases"]).items()},
             )
+        elif op == OP_SPLIT_PARTITION:
+            self._apply_split(
+                str(cmd["topic"]), int(cmd["partition"]),
+                int(cmd.get("watermark", 0)),
+            )
+        elif op == OP_SPLIT_CUTOVER:
+            self._apply_split_cutover(
+                str(cmd["topic"]), int(cmd["partition"]),
+                int(cmd.get("watermark", 0)),
+            )
+        elif op == OP_MERGE_PARTITIONS:
+            self._apply_merge(
+                str(cmd["topic"]), int(cmd["parent"]), int(cmd["child"])
+            )
         # Unknown ops are ignored (forward compatibility).
 
     def snapshot(self) -> dict:
@@ -277,6 +323,17 @@ class PartitionManager:
                 "stripe_holders": list(self.stripe_holders),
                 "follower_leases": {
                     str(b): int(e) for b, e in self.follower_leases.items()
+                },
+                # Elastic partitions: the dynamic slot extension and the
+                # open handoff windows ("topic|pid" keys — wire codecs
+                # want string map keys).
+                "dyn_slots": {
+                    f"{t}|{p}": int(s)
+                    for (t, p), s in self.dyn_slots.items()
+                },
+                "handoffs": {
+                    f"{t}|{p}": dict(h)
+                    for (t, p), h in self.handoffs.items()
                 },
             }
 
@@ -314,6 +371,17 @@ class PartitionManager:
             self.follower_leases = {
                 int(b): int(e)
                 for b, e in state.get("follower_leases", {}).items()
+            }
+            # Pre-elastic snapshots: no dynamic children, no handoffs.
+            self.dyn_slots = {
+                (k.rsplit("|", 1)[0], int(k.rsplit("|", 1)[1])): int(s)
+                for k, s in state.get("dyn_slots", {}).items()
+            }
+            self.handoffs = {
+                (k.rsplit("|", 1)[0], int(k.rsplit("|", 1)[1])):
+                    {"child": int(h["child"]),
+                     "watermark": int(h.get("watermark", 0))}
+                for k, h in state.get("handoffs", {}).items()
             }
             self._apply_set_topics(
                 topics_from_wire(state["topics"]),
@@ -363,6 +431,165 @@ class PartitionManager:
             int(b): int(e) for b, e in leases.items()
             if int(b) != self.controller_broker and b in self.standbys
         }
+
+    # ------------------------------------------- elastic-partition applies
+
+    def _used_slots_locked(self) -> set[int]:
+        return set(self.slot_map.values()) | set(self.dyn_slots.values())
+
+    def _next_spare_slot_locked(self) -> Optional[int]:
+        """Lowest engine slot not owned by any configured or dynamic
+        partition (deterministic: replicated state + config only)."""
+        used = self._used_slots_locked()
+        for s in range(self.config.engine.partitions):
+            if s not in used:
+                return s
+        return None
+
+    def _find_topic(self, name: str) -> Optional[int]:
+        for i, t in enumerate(self.topics):
+            if t.name == name:
+                return i
+        return None
+
+    def _replace_assignment(self, ti: int, assign: PartitionAssignment) -> None:
+        t = self.topics[ti]
+        assigns = tuple(
+            assign if a.partition_id == assign.partition_id else a
+            for a in t.assignments
+        )
+        self.topics[ti] = t.with_assignments(assigns)
+
+    def _apply_split(self, topic: str, pid: int, watermark: int) -> None:
+        """Split `pid`'s key-hash range at its midpoint into a new child
+        partition on a spare engine slot. Deterministic no-op when the
+        parent is missing, not active, un-splittable (range width < 2),
+        capped (split_max_partitions), or no spare slot remains."""
+        ti = self._find_topic(topic)
+        if ti is None:
+            return
+        t = self.topics[ti]
+        parent = t.assignment_for(pid)
+        if parent is None or parent.state != "active":
+            return
+        if parent.range_hi - parent.range_lo < 2:
+            return
+        cap = int(self.config.split_max_partitions)
+        if cap and t.partitions >= cap:
+            return
+        slot = self._next_spare_slot_locked()
+        if slot is None:
+            return
+        mid = (parent.range_lo + parent.range_hi) // 2
+        child_pid = t.partitions
+        gen = parent.generation + 1
+        new_parent = dataclasses.replace(
+            parent, generation=gen, range_hi=mid, state="handoff",
+        )
+        child = PartitionAssignment(
+            partition_id=child_pid,
+            replicas=parent.replicas,
+            # The child starts under the PARENT's leader (dual-write
+            # wants one serialization point); term 1 distinguishes the
+            # grant from "never led". An election re-places it freely.
+            leader=parent.leader,
+            term=max(1, parent.term),
+            generation=gen,
+            range_lo=mid,
+            range_hi=parent.range_hi,
+            state="handoff",
+            origin=pid,
+        )
+        assigns = tuple(
+            new_parent if a.partition_id == pid else a
+            for a in t.assignments
+        ) + (child,)
+        self.topics[ti] = dataclasses.replace(
+            t, partitions=t.partitions + 1, assignments=assigns,
+        )
+        self.dyn_slots[(topic, child_pid)] = slot
+        self.handoffs[(topic, pid)] = {
+            "child": child_pid, "watermark": int(watermark),
+        }
+        # Fence discipline: revoke every follower-read lease FIRST —
+        # the lease duty re-grants (same epoch) only after this apply
+        # is visible everywhere, so no standby serves the pre-split
+        # routing while the child's floor comes live.
+        self.follower_leases = {}
+        if self.dataplane is not None:
+            self._push_control_tables()
+        if self.recorder is not None:
+            self.recorder.record(
+                "split_begin", topic=topic, partition=pid,
+                child=child_pid, slot=slot, mid=mid, generation=gen,
+                watermark=int(watermark),
+            )
+
+    def _apply_split_cutover(self, topic: str, pid: int,
+                             watermark: int) -> None:
+        """Close a handoff window: parent and child both return to
+        "active" under a bumped generation (clients still stamped with
+        the handoff generation re-resolve). The proposer (controller
+        reconfig duty) gates this on the parent's settled floor having
+        reached the split-begin watermark."""
+        ho = self.handoffs.get((topic, pid))
+        if ho is None:
+            return
+        ti = self._find_topic(topic)
+        if ti is None:
+            return
+        t = self.topics[ti]
+        parent = t.assignment_for(pid)
+        child = t.assignment_for(int(ho["child"]))
+        if parent is None or child is None or parent.state != "handoff":
+            self.handoffs.pop((topic, pid), None)
+            return
+        gen = max(parent.generation, child.generation) + 1
+        self._replace_assignment(ti, dataclasses.replace(
+            parent, generation=gen, state="active"))
+        self._replace_assignment(ti, dataclasses.replace(
+            child, generation=gen, state="active"))
+        self.handoffs.pop((topic, pid), None)
+        if self.recorder is not None:
+            self.recorder.record(
+                "split_cutover", topic=topic, partition=pid,
+                child=int(ho["child"]), generation=gen,
+                watermark=int(watermark),
+            )
+
+    def _apply_merge(self, topic: str, parent_pid: int,
+                     child_pid: int) -> None:
+        """Reabsorb an adjacent split child's range into its parent and
+        retire the child. No-op unless (parent, child) is an active
+        split pair with adjacent ranges and no open handoff."""
+        ti = self._find_topic(topic)
+        if ti is None:
+            return
+        t = self.topics[ti]
+        parent = t.assignment_for(parent_pid)
+        child = t.assignment_for(child_pid)
+        if parent is None or child is None:
+            return
+        if child.origin != parent_pid or (topic, parent_pid) in self.handoffs:
+            return
+        if parent.state != "active" or child.state != "active":
+            return
+        if parent.range_hi != child.range_lo:
+            return  # not adjacent (an intervening split re-carved it)
+        gen = max(parent.generation, child.generation) + 1
+        self._replace_assignment(ti, dataclasses.replace(
+            parent, generation=gen, range_hi=child.range_hi))
+        self._replace_assignment(ti, dataclasses.replace(
+            child, generation=gen, range_lo=child.range_hi,
+            state="retired"))
+        # Same fence as the split: routing changed, revoke leases; the
+        # duty re-grants under the unchanged epoch.
+        self.follower_leases = {}
+        if self.recorder is not None:
+            self.recorder.record(
+                "merge_done", topic=topic, partition=parent_pid,
+                child=child_pid, generation=gen,
+            )
 
     def _apply_register_consumer(self, name: str, slot: int) -> None:
         """Idempotent consumer registration. The proposed slot was chosen
@@ -486,24 +713,72 @@ class PartitionManager:
             for j, a in enumerate(assigns):
                 ca = cur.assignment_for(a.partition_id) if cur else None
                 if full_surface:
-                    if ca is None or ca.term <= a.term:
+                    if ca is None:
                         continue
-                    keep = ca.leader if (ca.leader is None
-                                         or ca.leader in a.replicas) else None
-                    assigns[j] = dataclasses.replace(
-                        a, leader=keep, term=ca.term
-                    )
+                    keep_elastic = ca.generation > a.generation
+                    if ca.term <= a.term and not keep_elastic:
+                        continue
+                    upd = a
+                    if ca.term > a.term:
+                        keep = ca.leader if (
+                            ca.leader is None or ca.leader in a.replicas
+                        ) else None
+                        upd = dataclasses.replace(
+                            upd, leader=keep, term=ca.term
+                        )
+                    if keep_elastic:
+                        # Generations only move forward, like terms: a
+                        # snapshot taken before a local split/merge
+                        # applied must not regress the routing surface.
+                        upd = dataclasses.replace(
+                            upd, generation=ca.generation,
+                            range_lo=ca.range_lo, range_hi=ca.range_hi,
+                            state=ca.state, origin=ca.origin,
+                        )
+                    assigns[j] = upd
                 elif ca is None:
-                    # New partition: no leader until OP_SET_LEADER.
-                    assigns[j] = dataclasses.replace(a, leader=None, term=0)
+                    # New partition: no leader until OP_SET_LEADER. Its
+                    # genesis key-hash range is its 1/n-th share of the
+                    # space (the payload is placement-stripped): with
+                    # the overlapping full-range defaults, route_key
+                    # would send every key to pid 0 and a split child's
+                    # range would stay shadowed by its full-range
+                    # siblings forever.
+                    n = max(1, int(t.partitions))
+                    assigns[j] = dataclasses.replace(
+                        a, leader=None, term=0,
+                        range_lo=(RANGE_SPACE * a.partition_id) // n,
+                        range_hi=(RANGE_SPACE * (a.partition_id + 1)) // n,
+                    )
                 else:
                     keep = (ca.leader
                             if ca.leader is not None
                             and ca.leader in a.replicas else None)
+                    # The elastic surface (generation/range/state/
+                    # origin) is owned by the split/merge applies, same
+                    # as (leader, term) is owned by OP_SET_LEADER:
+                    # source it from the replicated current table, not
+                    # the (stripped) placement payload.
                     assigns[j] = dataclasses.replace(
-                        a, leader=keep, term=ca.term
+                        a, leader=keep, term=ca.term,
+                        generation=ca.generation, range_lo=ca.range_lo,
+                        range_hi=ca.range_hi, state=ca.state,
+                        origin=ca.origin,
                     )
-            merged.append(t.with_assignments(tuple(assigns)))
+            npids = {a.partition_id for a in assigns}
+            nparts = t.partitions
+            if cur is not None:
+                # Dynamic split children live past the configured shape:
+                # a placement payload built from config.topics (the
+                # assigner's shape) must never drop them.
+                for ca in cur.assignments:
+                    if ca.partition_id not in npids:
+                        assigns.append(ca)
+                nparts = max(nparts, cur.partitions, len(assigns))
+            assigns.sort(key=lambda a: a.partition_id)
+            merged.append(dataclasses.replace(
+                t, partitions=nparts, assignments=tuple(assigns),
+            ))
         topics = merged
         self.topics = topics
         self.live = live
@@ -539,7 +814,7 @@ class PartitionManager:
                     assigns[j] = dataclasses.replace(a, leader=leader, term=term)
             self.topics[i] = t.with_assignments(tuple(assigns))
         if self.dataplane is not None:
-            slot = self.slot_map.get((topic, pid))
+            slot = self._slot_for(topic, pid)
             if slot is not None:
                 assign = self.assignment_of((topic, pid))
                 leader_slot = -1
@@ -549,6 +824,17 @@ class PartitionManager:
 
     # -------------------------------------------------- control-table sync
 
+    def _slot_for(self, topic: str, pid: int) -> Optional[int]:
+        """(topic, pid) → engine slot across BOTH maps: the static
+        config-derived map and the replicated dynamic extension split
+        children live in. Lock not required — the static map is
+        immutable and dyn_slots reads ride the caller's apply lock or
+        tolerate a racy miss (same contract as slot_map.get did)."""
+        slot = self.slot_map.get((topic, pid))
+        if slot is None:
+            slot = self.dyn_slots.get((topic, pid))
+        return slot
+
     def _alive_mask(self) -> np.ndarray:
         """[P, R] mask: replica slot r of partition p is alive iff the
         broker holding it is in the live set. Unassigned slots are dead."""
@@ -557,7 +843,7 @@ class PartitionManager:
         live = set(self.live)
         for t in self.topics:
             for a in t.assignments:
-                slot = self.slot_map.get((t.name, a.partition_id))
+                slot = self._slot_for(t.name, a.partition_id)
                 if slot is None:
                     continue
                 for r, b in enumerate(a.replicas[: cfg.replicas]):
@@ -566,11 +852,17 @@ class PartitionManager:
 
     def _push_control_tables(self) -> None:
         cfg = self.dataplane.cfg
-        quorum = np.full((cfg.partitions,), cfg.quorum, np.int32)
+        # Unassigned slots (the SPARE pool splits spend) carry NO quorum
+        # contract: quorum 0 over an all-dead alive row, so they never
+        # read as quorum-lost (degraded_slots / the SLO shed signal
+        # would otherwise see every spare slot as permanently degraded
+        # and shed a healthy cluster). A split's apply re-pushes these
+        # tables, promoting the child slot to its topic's real quorum.
+        quorum = np.zeros((cfg.partitions,), np.int32)
         for t in self.topics:
             q = t.replication_factor // 2 + 1
             for a in t.assignments:
-                slot = self.slot_map.get((t.name, a.partition_id))
+                slot = self._slot_for(t.name, a.partition_id)
                 if slot is None:
                     continue
                 quorum[slot] = q
@@ -589,7 +881,8 @@ class PartitionManager:
         leaderless lags the eventual leader by log_end, which is exactly
         what plan_repairs keys on)."""
         pairs: dict[tuple[int, int], list[int]] = {}
-        for key, slot in self.slot_map.items():
+        for key, slot in list(self.slot_map.items()) + list(
+                self.dyn_slots.items()):
             assign = self.assignment_of(key)
             if assign is None or assign.leader is None:
                 continue
@@ -626,7 +919,7 @@ class PartitionManager:
             pairs: dict[tuple[int, int], list[int]] = {}
             for t in self.topics:
                 for a in t.assignments:
-                    slot = self.slot_map.get((t.name, a.partition_id))
+                    slot = self._slot_for(t.name, a.partition_id)
                     if slot is None or a.leader is None or a.leader not in live:
                         continue
                     if a.leader not in a.replicas:
@@ -717,7 +1010,8 @@ class PartitionManager:
             return a.leader if a else None
 
     def slot_of(self, key: GroupKey) -> Optional[int]:
-        return self.slot_map.get(key)
+        with self.lock:
+            return self._slot_for(key[0], key[1])
 
     def replica_slot(self, key: GroupKey, broker_id: int) -> Optional[int]:
         """This broker's replica-slot index within the partition's set."""
@@ -726,6 +1020,94 @@ class PartitionManager:
             if a is None or broker_id not in a.replicas:
                 return None
             return a.replicas.index(broker_id)
+
+    def generation_of(self, key: GroupKey) -> Optional[int]:
+        """Current reconfiguration generation of one partition (None =
+        unknown partition) — what request-stamped `pgen` fences against."""
+        with self.lock:
+            a = self.assignment_of(key)
+            return a.generation if a else None
+
+    def route_key(self, topic: str, key_hash: int) -> Optional[int]:
+        """The NON-RETIRED partition owning `key_hash`'s range slice
+        (None when the topic is unknown). During a handoff the child
+        already owns the migrated slice — routing truth moves at split
+        begin; the parent's dual-write forward covers stale senders."""
+        with self.lock:
+            for t in self.topics:
+                if t.name != topic:
+                    continue
+                for a in t.assignments:
+                    if a.state != "retired" and a.owns_key(int(key_hash)):
+                        return a.partition_id
+            return None
+
+    def current_handoffs(self) -> dict[GroupKey, dict]:
+        """Locked copy of the open handoff windows (the controller's
+        reconfig duty drives each to cutover)."""
+        with self.lock:
+            return {k: dict(h) for k, h in self.handoffs.items()}
+
+    def merge_candidates(self) -> list[tuple[str, int, int]]:
+        """(topic, parent, child) triples currently mergeable: active
+        split children whose range is still adjacent to their parent's
+        and whose parent has no open handoff."""
+        with self.lock:
+            out = []
+            for t in self.topics:
+                for a in t.assignments:
+                    if a.origin < 0 or a.state != "active":
+                        continue
+                    if (t.name, a.origin) in self.handoffs:
+                        continue
+                    p = t.assignment_for(a.origin)
+                    if (p is not None and p.state == "active"
+                            and p.range_hi == a.range_lo):
+                        out.append((t.name, a.origin, a.partition_id))
+            return out
+
+    def spare_slot_count(self) -> int:
+        with self.lock:
+            return self.config.engine.partitions - len(
+                self._used_slots_locked()
+            )
+
+    def mapped_slots(self) -> set[int]:
+        """Every engine slot the topic table currently maps (static
+        config slots + dynamic split children) — what the follower
+        plane prunes its per-slot serve state against."""
+        with self.lock:
+            return self._used_slots_locked()
+
+    def reconfig_stats(self) -> dict:
+        """The admin.stats `reconfig` block's replicated half (the
+        server adds its local forward/fence counters): split/merge
+        topology derived from the topic table, open handoffs, and the
+        spare-slot pool."""
+        with self.lock:
+            children = retired = handoff = 0
+            for t in self.topics:
+                for a in t.assignments:
+                    if a.origin >= 0:
+                        children += 1
+                    if a.state == "retired":
+                        retired += 1
+                    elif a.state == "handoff":
+                        handoff += 1
+            return {
+                "children": children,
+                "retired": retired,
+                "handoff_partitions": handoff,
+                "open_handoffs": [
+                    {"topic": t, "partition": p,
+                     "child": int(h["child"]),
+                     "watermark": int(h["watermark"])}
+                    for (t, p), h in sorted(self.handoffs.items())
+                ],
+                "spare_slots": self.config.engine.partitions - len(
+                    self._used_slots_locked()
+                ),
+            }
 
     def consumer_slot(self, consumer: str) -> Optional[int]:
         with self.lock:
@@ -942,7 +1324,7 @@ class PartitionManager:
             for t in self.topics:
                 quorum = t.replication_factor // 2 + 1
                 for a in t.assignments:
-                    slot = self.slot_map.get((t.name, a.partition_id))
+                    slot = self._slot_for(t.name, a.partition_id)
                     if a.leader is not None and a.leader in live:
                         if slot is None:
                             continue
@@ -1005,7 +1387,7 @@ class PartitionManager:
             drafts: dict[int, dict] = {}
             for t in self.topics:
                 for a in t.assignments:
-                    slot = self.slot_map.get((t.name, a.partition_id))
+                    slot = self._slot_for(t.name, a.partition_id)
                     if slot is None:
                         continue
                     skew = False

@@ -52,11 +52,14 @@ from ripplemq_tpu.broker.manager import (
     OP_GROUP_DELETE,
     OP_GROUP_JOIN,
     OP_GROUP_LEAVE,
+    OP_MERGE_PARTITIONS,
     OP_REGISTER_CONSUMER,
     OP_REGISTER_PRODUCER,
     OP_RETIRE_PRODUCER,
     OP_SET_FOLLOWER_LEASES,
     OP_SET_STANDBYS,
+    OP_SPLIT_CUTOVER,
+    OP_SPLIT_PARTITION,
     ConsumerTableFullError,
     PartitionManager,
 )
@@ -481,10 +484,20 @@ class BrokerServer:
         # NodeOptions.setElectionTimeoutMs, TopicsRaftServer.java:131).
         etick = max(2, int(round(config.metadata_election_timeout_s
                                  / tick_interval_s)))
+        # Controllership-claim provenance (consumed by _takeover_duty):
+        # an OP_SET_CONTROLLER that applies at a raft index BEYOND the
+        # restored log's end is a live promotion this process witnessed;
+        # a claim held without one is recovered (or genesis-config)
+        # state. The distinction matters because a restarted
+        # controller's own store may have silently lost its acked tail
+        # (torn-tail trim is a legitimate crash repair), while a live
+        # promotion's store was acked complete by construction.
+        self._recovered_raft_end = 0
+        self._promoted_live = False
         node = RaftNode(
             broker_id,
             config.broker_ids(),
-            apply_fn=self.manager.apply,
+            apply_fn=self._apply_committed,
             snapshot_fn=self.manager.snapshot,
             restore_fn=self.manager.restore,
             election_ticks=(etick, 2 * etick),
@@ -496,6 +509,7 @@ class BrokerServer:
             saved = self._metastore.load()
             if saved is not None:
                 node.restore(saved)
+                self._recovered_raft_end = node.last_index()
         self.runner = RaftRunner(
             node,
             self._raft_client,
@@ -551,6 +565,20 @@ class BrokerServer:
         self._last_membership_poll = 0.0
         # Follower-lease grant debounce (_follower_lease_duty).
         self._last_lease_grant = 0.0
+        # Elastic-partition reconfiguration (split/merge) surface:
+        # dual-write forwards this broker served as a handoff leader,
+        # generation-fence refusals it answered (both land in the
+        # admin.stats `reconfig` block), and the reconfig duty's LOCAL
+        # first-seen clock per open handoff window — the
+        # split_handoff_timeout_s bound is a duty deadline, not
+        # replicated state: a controller failover restarts the clock,
+        # which delays the cutover but never loses it.
+        self._forwarded_writes = 0
+        self._gen_fence_refusals = 0
+        self._handoff_seen: dict = {}
+        # Auto-split heat ranking: (topic, pid) → committed log end at
+        # the previous duty pass (duty thread only).
+        self._autosplit_prev_ends: dict = {}
         # Repair-scan cadence (see _controller_duty): lag repair needs a
         # device fetch, so it must not ride every duty tick.
         self._last_repair_scan = 0.0
@@ -949,6 +977,10 @@ class BrokerServer:
                 return self._handle_repl_stripes(req)
             if t == "stripe.fetch":
                 return self._handle_stripe_fetch(req)
+            if t == "admin.split":
+                return self._handle_admin_split(req)
+            if t == "admin.merge":
+                return self._handle_admin_merge(req)
             if t == "admin.stats":
                 return self._handle_stats(req)
             if t == "admin.metrics":
@@ -1026,6 +1058,14 @@ class BrokerServer:
                 str(a.partition_id): {
                     "leader": a.leader, "term": a.term,
                     "replicas": list(a.replicas),
+                    # Elastic-partition surface: reconfiguration
+                    # generation, owned key-hash range, lifecycle state
+                    # (active | handoff | retired), parent pid for
+                    # split children (-1 = configured partition).
+                    "generation": a.generation,
+                    "range": [a.range_lo, a.range_hi],
+                    "state": a.state,
+                    "origin": a.origin,
                 }
                 for a in t.assignments
             }
@@ -1088,6 +1128,16 @@ class BrokerServer:
         # (`enabled: false` shape when the loop is off — the admission
         # counters still live there, quotas work without the loop).
         stats["slo"] = self.slo.stats()
+        # Elastic partitions: the replicated split/merge topology
+        # (children, retired, open handoff windows, spare-slot pool)
+        # plus THIS broker's local reconfiguration counters — dual-
+        # write forwards it served as a handoff leader, generation-
+        # fence refusals it answered. The chaos reconfig verdict reads
+        # this block on every broker and sums the local halves.
+        reconfig = self.manager.reconfig_stats()
+        reconfig["forwarded_writes"] = self._forwarded_writes
+        reconfig["fence_refusals"] = self._gen_fence_refusals
+        stats["reconfig"] = reconfig
         # Follower read plane: lease table + this broker's own serving
         # counters (floor lag, cache hit rate, reads served/refused).
         # `enabled: false` shape when the knob is off — the lease keys
@@ -1691,6 +1741,60 @@ class BrokerServer:
             }
         return slot, None
 
+    def _topic_routing(self, topic: str) -> list[dict]:
+        """The topic's current assignments on the wire — what a
+        `stale_partition_gen:` refusal carries so the refused client
+        re-resolves routing FROM THE REFUSAL (generation, ranges,
+        leaders) instead of spending a meta.topics round first."""
+        for t in self.manager.get_topics():
+            if t.name == topic:
+                return [a.to_dict() for a in t.assignments]
+        return []
+
+    def _gen_refusal(self, req: dict, key) -> Optional[dict]:
+        """Partition-generation fence (elastic partitions): a request
+        stamped with `pgen` — the generation its sender resolved
+        routing under — draws a typed RETRYABLE `stale_partition_gen:`
+        refusal the moment a split/merge has bumped the partition's
+        generation, with the topic's current assignments attached (the
+        groups plane's fenced_generation discipline reapplied to
+        partitions). Replicated state only, so EVERY broker fences
+        identically. Unstamped requests keep the legacy contract:
+        routed by partition id, with keyed writes to a splitting
+        parent dual-write-forwarded instead of refused."""
+        pgen = req.get("pgen")
+        if pgen is None:
+            return None
+        gen = self.manager.generation_of(key)
+        if gen is None or int(pgen) == gen:
+            return None
+        self._gen_fence_refusals += 1
+        return {
+            "ok": False,
+            "error": f"stale_partition_gen: {key[0]}/{key[1]} generation "
+                     f"{int(pgen)} != current {gen}",
+            "generation": gen,
+            "routing": self._topic_routing(key[0]),
+        }
+
+    def _retired_refusal(self, key) -> Optional[dict]:
+        """Produce-side fence for a merge-retired child: its log stays
+        readable for draining, but new writes must land in the parent
+        that reabsorbed the range — same typed refusal + routing
+        payload as the generation fence, so one client re-resolve
+        handles both."""
+        a = self.manager.assignment_of(key)
+        if a is None or a.state != "retired":
+            return None
+        self._gen_fence_refusals += 1
+        return {
+            "ok": False,
+            "error": f"stale_partition_gen: {key[0]}/{key[1]} is retired "
+                     f"(range merged into partition {a.origin})",
+            "generation": a.generation,
+            "routing": self._topic_routing(key[0]),
+        }
+
     def _handle_produce(self, req: dict) -> dict:
         """Admission + ack-latency instrumentation around the produce
         path. Admission runs FIRST — before partition resolution,
@@ -1713,9 +1817,11 @@ class BrokerServer:
             self._m_ack_us.observe(self.metrics.clock() - t0)
 
     # Fields the raw-dispatch peek materializes: the routing/admission
-    # scalars plus the message VECTOR's element count (never its bytes).
+    # scalars (including the elastic-partition fence/routing stamps
+    # pgen + key_hash) plus the message VECTOR's element count (never
+    # its bytes).
     _RAW_PEEK = ("type", "topic", "partition", "producer", "pid", "seq",
-                 "messages")
+                 "pgen", "key_hash", "messages")
 
     def _raw_produce(self, body) -> Optional[dict]:
         """Raw-frame produce dispatch (TcpServer accept path, host-plane
@@ -1772,6 +1878,27 @@ class BrokerServer:
         reproducibly (max_batch is config-static), so a full-batch replay
         re-chunks identically and every chunk dedupes."""
         key = group_key(req["topic"], req["partition"])
+        refusal = self._gen_refusal(req, key)
+        if refusal:
+            return refusal
+        routed = None
+        khash = req.get("key_hash")
+        if khash is not None:
+            owner = self.manager.route_key(req["topic"], int(khash))
+            if owner is not None and owner != key[1]:
+                # Elastic routing moved this key's range slice (a split
+                # begun, a merge landed) and the sender has not
+                # re-resolved: FORWARD the write to the current owner
+                # instead of refusing — during a handoff the child's
+                # leader IS the parent's, so the dual-write is a local
+                # slot redirect, and the ack names the routed partition
+                # (`routed_partition`) so the sender's history stays
+                # attributable to the log the write actually landed in.
+                key = group_key(req["topic"], owner)
+                routed = owner
+        refusal = self._retired_refusal(key)
+        if refusal:
+            return refusal
         slot, refusal = self._check_partition(key)
         if refusal:
             return refusal
@@ -1874,6 +2001,10 @@ class BrokerServer:
         if first_err is not None:
             return {"ok": False, "error": f"not_committed: {first_err}",
                     "committed": committed}
+        if routed is not None:
+            self._forwarded_writes += 1
+            return {"ok": True, "base_offset": base0, "count": committed,
+                    "routed_partition": routed}
         return {"ok": True, "base_offset": base0, "count": committed}
 
     def _quorum_refusal(self, slot: int) -> Optional[dict]:
@@ -1906,6 +2037,9 @@ class BrokerServer:
 
     def _consume_checked(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
+        refusal = self._gen_refusal(req, key)
+        if refusal:
+            return refusal
         slot, refusal = self._check_partition(key)
         if refusal:
             # Follower read path: a non-leader with a valid lease may
@@ -2049,6 +2183,9 @@ class BrokerServer:
 
     def _handle_offset_commit(self, req: dict) -> dict:
         key = group_key(req["topic"], req["partition"])
+        refusal = self._gen_refusal(req, key)
+        if refusal:
+            return refusal
         slot, refusal = self._check_partition(key)
         if refusal:
             return refusal
@@ -2114,6 +2251,118 @@ class BrokerServer:
             topic=key[0], partition=key[1],
         )
         return {"ok": False, "error": f"fenced_generation: {why}"}
+
+    # -- elastic partitions (online split/merge) ---------------------------
+
+    def _handle_admin_split(self, req: dict) -> dict:
+        """Operator/nemesis surface: begin an online split of one
+        partition. The proposal carries the parent's device-committed
+        log end as the cutover WATERMARK — every write acked before
+        this moment lives at or below it, and the reconfig duty gates
+        the cutover on the parent's SETTLED floor crossing it (or the
+        split_handoff_timeout_s bound), so the routing flip never
+        strands an acked write behind an unreplicated prefix. The
+        apply re-validates everything and deterministically no-ops
+        when infeasible; the pre-checks here just turn the common
+        no-op causes into typed answers instead of a timeout."""
+        topic = str(req["topic"])
+        pid = int(req["partition"])
+        key = group_key(topic, pid)
+        a = self.manager.assignment_of(key)
+        if a is None:
+            return {"ok": False, "error": f"unknown_partition: {key}"}
+        if a.state != "active":
+            return {"ok": False,
+                    "error": f"split_infeasible: {topic}/{pid} is in "
+                             f"state {a.state!r}"}
+        if a.range_hi - a.range_lo < 2:
+            return {"ok": False,
+                    "error": f"split_infeasible: {topic}/{pid} range "
+                             f"[{a.range_lo}, {a.range_hi}) is too "
+                             f"narrow to split"}
+        if self.manager.spare_slot_count() <= 0:
+            return {"ok": False,
+                    "error": "split_infeasible: no spare engine slot "
+                             "(engine.partitions is a device-static "
+                             "shape; splits spend pre-provisioned "
+                             "spares)"}
+        slot = self.manager.slot_of(key)
+        try:
+            watermark = self._engine_log_end(slot)
+        except (RpcError, NotCommittedError) as e:
+            return {"ok": False,
+                    "error": f"not_committed: split watermark "
+                             f"unobservable: {e}"}
+        gen0 = a.generation
+        if not self.propose_cmd({
+            "op": OP_SPLIT_PARTITION, "topic": topic, "partition": pid,
+            "watermark": int(watermark),
+        }):
+            return {"ok": False,
+                    "error": "not_committed: split not proposed"}
+        deadline = time.monotonic() + self.config.rpc_timeout_s
+        while time.monotonic() < deadline:
+            ho = self.manager.current_handoffs().get(key)
+            if ho is not None:
+                return {"ok": True, "child": int(ho["child"]),
+                        "watermark": int(ho["watermark"]),
+                        "generation": self.manager.generation_of(key)}
+            na = self.manager.assignment_of(key)
+            if na is not None and na.generation > gen0:
+                # Begun AND cut over between polls: an idle parent's
+                # settled floor is already at the watermark, so the
+                # reconfig duty closes the window in one pass. The
+                # child is the adjacent assignment this split minted.
+                child = next(
+                    (c.partition_id
+                     for t in self.manager.get_topics() if t.name == topic
+                     for c in t.assignments
+                     if c.origin == pid and c.range_lo == na.range_hi),
+                    None,
+                )
+                if child is not None:
+                    return {"ok": True, "child": int(child),
+                            "watermark": int(watermark),
+                            "generation": na.generation}
+            time.sleep(0.01)
+        # Committed but no handoff window: the apply no-opped (a racing
+        # split/merge changed feasibility between pre-check and apply).
+        return {"ok": False,
+                "error": "not_committed: split applied as a no-op "
+                         "(feasibility changed in flight); re-resolve "
+                         "and retry"}
+
+    def _handle_admin_merge(self, req: dict) -> dict:
+        """Reverse op: reabsorb an active split child into its parent.
+        Validated against the manager's merge-candidate view (adjacent
+        ranges, both active, no open handoff) — the apply re-checks the
+        same conditions, so a racing proposal no-ops."""
+        topic = str(req["topic"])
+        parent = int(req["parent"])
+        child = int(req["child"])
+        if (topic, parent, child) not in self.manager.merge_candidates():
+            return {"ok": False,
+                    "error": f"merge_infeasible: {topic}/{parent}+"
+                             f"{child} is not an adjacent active "
+                             f"split pair"}
+        if not self.propose_cmd({
+            "op": OP_MERGE_PARTITIONS, "topic": topic,
+            "parent": parent, "child": child,
+        }):
+            return {"ok": False,
+                    "error": "not_committed: merge not proposed"}
+        deadline = time.monotonic() + self.config.rpc_timeout_s
+        while time.monotonic() < deadline:
+            ca = self.manager.assignment_of(group_key(topic, child))
+            if ca is not None and ca.state == "retired":
+                return {"ok": True,
+                        "generation": self.manager.generation_of(
+                            group_key(topic, parent))}
+            time.sleep(0.01)
+        return {"ok": False,
+                "error": "not_committed: merge applied as a no-op "
+                         "(pair no longer mergeable); re-resolve and "
+                         "retry"}
 
     # -- producers / groups ------------------------------------------------
 
@@ -2630,6 +2879,16 @@ class BrokerServer:
         )
         return list(resp["messages"]), int(resp["end"])
 
+    def _engine_log_end(self, slot: int) -> int:
+        """The slot's device-committed absolute log end, from the local
+        plane or the controller's (the split watermark observation —
+        admin.split can be served by any broker)."""
+        dp = self._local_engine()
+        if dp is not None:
+            return dp.log_end(slot)
+        resp = self._engine_call({"type": "engine.log_end", "slot": slot})
+        return int(resp["end"])
+
     def _engine_read_offset(self, slot: int, cslot: int, replica: int = 0) -> int:
         dp = self._local_engine()
         if dp is not None:
@@ -2688,6 +2947,8 @@ class BrokerServer:
             return {"ok": True, "offset": dp.read_offset(
                 int(req["slot"]), int(req["cslot"]),
                 int(req.get("replica", 0)))}
+        if t == "engine.log_end":
+            return {"ok": True, "end": dp.log_end(int(req["slot"]))}
         if t == "engine.offsets":
             refusal = self._quorum_refusal(int(req["slot"]))
             if refusal:
@@ -2959,6 +3220,8 @@ class BrokerServer:
                 self._slot_clean_duty()
                 self._standby_duty()
                 self._follower_lease_duty()
+                self._reconfig_duty()
+                self._autosplit_duty()
                 self._shard_duty()
             except Exception as e:  # duties must never kill the loop
                 log.warning("broker %d duty error: %s: %s",
@@ -2994,6 +3257,124 @@ class BrokerServer:
                 "follower_lease", epoch=epoch,
                 brokers=sorted(desired),
             )
+
+    def _reconfig_duty(self) -> None:
+        """Controller: drive every open split-handoff window to
+        cutover, plus every broker's local follower-plane slot prune.
+        The cutover gate is the parent's SETTLED floor crossing the
+        split-begin watermark — every write acked before the split
+        began is then replicated to the full standby set, so the
+        final routing flip survives a controller death the next
+        instant. A floor that cannot advance (quorum loss mid-handoff)
+        falls back to the split_handoff_timeout_s LOCAL deadline so
+        the window is always bounded; the deadline clock restarts on
+        failover, which delays — never loses — the cutover, because
+        the handoff window itself is replicated metadata the promoted
+        controller sees on its first duty pass."""
+        if self.follower_plane is not None:
+            # Satellite of the same transition: serve state for slots
+            # the topic table no longer maps must not dangle (and a
+            # reused slot must not inherit a dead partition's floor).
+            self.follower_plane.prune_slots(self.manager.mapped_slots())
+        dp = self._local_engine()
+        if dp is None:
+            self._handoff_seen.clear()
+            return
+        open_ho = self.manager.current_handoffs()
+        for k in list(self._handoff_seen):
+            if k not in open_ho:
+                del self._handoff_seen[k]
+        now = time.monotonic()
+        for (topic, pid), ho in open_ho.items():
+            first = self._handoff_seen.setdefault((topic, pid), now)
+            slot = self.manager.slot_of(group_key(topic, pid))
+            if slot is None:
+                continue
+            timed_out = (now - first
+                         >= self.config.split_handoff_timeout_s)
+            if dp.settled_end(slot) < int(ho["watermark"]) \
+                    and not timed_out:
+                continue
+            if self.propose_cmd({
+                "op": OP_SPLIT_CUTOVER, "topic": topic,
+                "partition": pid, "watermark": int(ho["watermark"]),
+            }, retries=1) and timed_out:
+                log.warning(
+                    "broker %d: split cutover for %s/%d forced by "
+                    "handoff timeout (settled %d < watermark %d)",
+                    self.broker_id, topic, pid,
+                    dp.settled_end(slot), int(ho["watermark"]),
+                )
+
+    def _autosplit_duty(self) -> None:
+        """Controller broker: the SLO→topology closed loop. When the
+        SloController's tick history arms a split (`split_auto` with a
+        sustained produce-SLO breach), propose an online split of the
+        HOTTEST splittable partition — ranked by committed log-end
+        growth between duty passes, a host-side observation off the
+        local device plane, no device work. When the history arms a
+        merge instead (deep comfortable/idle hysteresis), reabsorb one
+        split child. Runs only where the device plane lives — the same
+        broker whose engine-side signals feed the shed machine — so
+        exactly one broker arbitrates; the apply's deterministic no-op
+        guards make a raced duplicate proposal harmless regardless."""
+        if not self.config.split_auto:
+            return
+        dp = self._local_engine()
+        if dp is None:
+            self._autosplit_prev_ends = {}
+            return
+        # Snapshot log ends EVERY pass (the ranking must already have a
+        # baseline the moment the evidence arms), and rank while at it.
+        prev = self._autosplit_prev_ends
+        cur: dict = {}
+        hottest = None
+        hottest_delta = -1
+        for t in self.manager.get_topics():
+            for a in t.assignments:
+                if a.state != "active":
+                    continue
+                key = group_key(t.name, a.partition_id)
+                slot = self.manager.slot_of(key)
+                if slot is None:
+                    continue
+                cur[key] = end = dp.log_end(slot)
+                if a.range_hi - a.range_lo < 2:
+                    continue  # too narrow to split: never a candidate
+                delta = end - prev.get(key, end)
+                if delta > hottest_delta:
+                    hottest_delta, hottest = delta, key
+        self._autosplit_prev_ends = cur
+        if self.manager.current_handoffs():
+            return  # one reconfiguration window in flight at a time
+        if self.slo.split_wanted():
+            if hottest is None or self.manager.spare_slot_count() <= 0:
+                return  # stay armed; feasibility may return
+            topic, pid = hottest
+            if self.propose_cmd({
+                "op": OP_SPLIT_PARTITION, "topic": topic,
+                "partition": pid, "watermark": int(cur[hottest]),
+            }, retries=1):
+                self.slo.note_reconfig()
+                log.warning(
+                    "broker %d: auto-split %s/%d (SLO breach; log-end "
+                    "delta %d this duty pass)",
+                    self.broker_id, topic, pid, hottest_delta,
+                )
+        elif self.slo.merge_wanted():
+            cands = self.manager.merge_candidates()
+            if not cands:
+                self.slo.note_reconfig()  # nothing to merge: disarm
+                return
+            topic, parent, child = cands[0]
+            if self.propose_cmd({
+                "op": OP_MERGE_PARTITIONS, "topic": topic,
+                "parent": parent, "child": child,
+            }, retries=1):
+                self.slo.note_reconfig()
+                log.info("broker %d: auto-merge %s/%d+%d (idle "
+                         "hysteresis)", self.broker_id, topic, parent,
+                         child)
 
     def _metadata_leader_duty(self) -> None:
         node = self.runner.node
@@ -3191,6 +3572,22 @@ class BrokerServer:
                     and node.max_commit_seen > 0
                     and node.last_applied >= node.max_commit_seen)
 
+    def _apply_committed(self, index: int, cmd: dict) -> None:
+        """Metadata apply hook (RaftNode.apply_fn): delegates to the
+        manager, then records whether this process has WITNESSED a live
+        transition into its own controllership. Judged by state change
+        rather than op shape so OP_BATCH wrapping and future op forms
+        stay covered; gated on the apply index so entries replayed out
+        of the restored log (index <= _recovered_raft_end) never count
+        as a live promotion."""
+        prev = self.manager.current_controller()
+        self.manager.apply(index, cmd)
+        if (not self._promoted_live
+                and index > self._recovered_raft_end
+                and prev != self.broker_id
+                and self.manager.current_controller() == self.broker_id):
+            self._promoted_live = True
+
     def _takeover_duty(self) -> None:
         """Promoted standby (and genesis/restarted controller): boot the
         device program from the local copy of the committed-round
@@ -3256,6 +3653,34 @@ class BrokerServer:
                 "abdicate to; booting empty", self.broker_id,
             )
             self._store_quarantined = False
+        if not self._promoted_live and self._recovered_raft_end > 0:
+            # This controllership claim was RECOVERED from disk, not
+            # won while running (genesis boots restore nothing; every
+            # live promotion flips _promoted_live in _apply_committed).
+            # A restarted controller's stream may have silently lost
+            # its acked tail — a torn tail is repaired by DROPPING it,
+            # a legitimate crash artifact — so booting from it can
+            # serve a shorter history than what producers were acked
+            # against (the proc split-chaos drill caught this as an
+            # offset regression: a commit acked 12 ms before SIGKILL
+            # vanished across the restart). Every settled round was
+            # acked by every standby-set member first, so hand
+            # controllership to one and let the whole copy win; this
+            # broker rejoins through catch-up like any abdication.
+            cmd = self.manager.plan_abdication()
+            if cmd is not None:
+                log.warning(
+                    "broker %d: restarted into a recovered controller "
+                    "claim; abdicating to broker %d rather than boot "
+                    "from a possibly torn local stream",
+                    self.broker_id, cmd["controller"],
+                )
+                self.propose_cmd(cmd)
+                return
+            # No live standby to hand to (or the recovered standby set
+            # is empty): the local copy is the best anyone has — adopt
+            # the claim and boot, same fallback as quarantine.
+            self._promoted_live = True
         self._boot_dataplane()
 
     def _controller_duty(self) -> None:

@@ -36,10 +36,14 @@ def small_engine(partitions: int, replicas: int, **kw) -> EngineConfig:
 
 
 def make_cluster_config(n_brokers=3, topics=None, engine=None,
-                        **kw) -> ClusterConfig:
+                        spare_slots=0, **kw) -> ClusterConfig:
+    """`spare_slots`: extra engine partition slots beyond the topics'
+    total — the pool online splits spend (broker/manager.py). The
+    default engine is sized exactly to the topic table, so elastic runs
+    must ask for spares explicitly."""
     topics = topics or (Topic("topic1", 2, 3), Topic("topic2", 1, 3))
     engine = engine or small_engine(
-        partitions=sum(t.partitions for t in topics),
+        partitions=sum(t.partitions for t in topics) + int(spare_slots),
         replicas=max(t.replication_factor for t in topics),
     )
     # Fast timings for in-proc runs; production defaults mirror the
@@ -156,6 +160,53 @@ class InProcCluster:
             if not b.stopped:
                 return b.manager.current_controller()
         return None
+
+    def topic_view(self, topic: str) -> list:
+        """One live broker's current assignment list for a topic
+        (PartitionAssignment objects, elastic surface included) — the
+        nemesis's split-candidate resolution and the harness's dynamic
+        final-log collection read this."""
+        for b in self.brokers.values():
+            if not b.stopped:
+                for t in b.manager.get_topics():
+                    if t.name == topic:
+                        return list(t.assignments)
+        return []
+
+    def merge_candidates(self) -> list:
+        """(topic, parent, child) triples currently mergeable, per a
+        live broker's replicated view."""
+        for b in self.brokers.values():
+            if not b.stopped:
+                return b.manager.merge_candidates()
+        return []
+
+    def admin_split(self, topic: str, pid: int) -> dict:
+        """Fire admin.split at any live broker (the handler proposes
+        through the metadata leader and polls its local apply)."""
+        return self._admin_call({"type": "admin.split", "topic": topic,
+                                 "partition": int(pid)})
+
+    def admin_merge(self, topic: str, parent: int, child: int) -> dict:
+        return self._admin_call({"type": "admin.merge", "topic": topic,
+                                 "parent": int(parent),
+                                 "child": int(child)})
+
+    def _admin_call(self, req: dict) -> dict:
+        client = self.client("reconfig")
+        last: dict = {"ok": False,
+                      "error": "unavailable: no live broker reachable"}
+        for bid, b in self.brokers.items():
+            if b.stopped:
+                continue
+            try:
+                last = client.call(self.broker_addr(bid), req, timeout=5.0)
+            except Exception as e:
+                last = {"ok": False,
+                        "error": f"unavailable: {type(e).__name__}: {e}"}
+                continue
+            return last
+        return last
 
     def controller_ready(self) -> bool:
         """Controller known with >= 1 replication standby joined (the

@@ -39,11 +39,19 @@ class _Workload:
 
     def __init__(self, cluster: InProcCluster, seed: int,
                  history: History, topic: str, partitions: int,
-                 follower_reads: bool = False) -> None:
+                 follower_reads: bool = False,
+                 keyed: bool = False) -> None:
         self.history = history
         self.topic = topic
         self.partitions = partitions
         self.follower_reads = follower_reads
+        # Elastic runs produce KEYED: the SDK resolves the partition by
+        # key-hash range, stamps pgen, and re-routes on the broker's
+        # stale_partition_gen fence — the workload then records the
+        # partition each ack actually LANDED in (producer.last_partition
+        # carries the broker's routed_partition), so the checker's
+        # acked-loss lookup hits the right final log across handoffs.
+        self.keyed = keyed
         self._stop = threading.Event()
         bootstrap = [b.address for b in cluster.config.brokers]
         # Short timeouts + a deadline budget per op: a faulted window
@@ -88,15 +96,25 @@ class _Workload:
         i = 0
         while not self._stop.is_set():
             pid = i % self.partitions
+            key = None
+            if self.keyed:
+                # 64 rotating keys: crc32 spreads them across the full
+                # hash range, so any split's child range owns some. The
+                # SDK routes; the pinned pid is only the pre-ack guess.
+                key = f"k{i % 64:02d}".encode()
             payload = f"w{self._seed}:{i}"
             # Record BEFORE the call: an acked-in-flight produce whose
-            # response is lost must not read as a phantom.
+            # response is lost must not read as a phantom. (History
+            # keeps the LAST record per payload, so the ok/fail below
+            # overwrites this placeholder — including its guessed
+            # partition, which a keyed reroute can change.)
             self.history.record(op="produce", client="producer",
                                 topic=self.topic, partition=pid,
                                 payload=payload, status="unknown")
             try:
-                self.producer.produce(self.topic, payload.encode(),
-                                      partition=pid)
+                self.producer.produce(
+                    self.topic, payload.encode(),
+                    partition=None if self.keyed else pid, key=key)
             except Exception as e:
                 self.history.record(
                     op="produce", client="producer", topic=self.topic,
@@ -105,6 +123,10 @@ class _Workload:
                                      "attempts", 1),
                     error=f"{type(e).__name__}: {e}")
             else:
+                if self.keyed and self.producer.last_partition is not None:
+                    # The partition the broker ACKED the write into —
+                    # the acked-loss check drains THAT log.
+                    pid = self.producer.last_partition
                 self.history.record(
                     op="produce", client="producer", topic=self.topic,
                     partition=pid, payload=payload, status="ok",
@@ -423,6 +445,132 @@ def check_slo(slo_stats: dict[str, dict], timeline: list[dict],
     return section, violations
 
 
+def _collect_reconfig(cluster) -> tuple[dict[str, dict], list[dict]]:
+    """One admin.stats `reconfig` block per reachable broker plus every
+    broker's flight-recorder reconfiguration events (split_begin /
+    split_cutover / merge_done), over the real transport — the
+    time-to-rebalance witness and the forward/fence counters both live
+    broker-side and survive the post-heal drain."""
+    stats: dict[str, dict] = {}
+    events: list[dict] = []
+    client = cluster.client("reconfig-collect")
+    for bid in cluster.brokers:
+        addr = cluster.broker_addr(bid)
+        try:
+            st = client.call(addr, {"type": "admin.stats"}, timeout=10.0)
+        except Exception:
+            st = {}
+        if st.get("ok") and isinstance(st.get("reconfig"), dict):
+            stats[str(bid)] = st["reconfig"]
+        try:
+            tr = client.call(addr, {"type": "admin.trace"}, timeout=10.0)
+        except Exception:
+            continue
+        if tr.get("ok"):
+            for ev in tr.get("trace", []):
+                if ev.get("type") in ("split_begin", "split_cutover",
+                                      "merge_done"):
+                    events.append({"src": f"broker{bid}", **ev})
+    return stats, events
+
+
+def check_reconfig(rstats: dict[str, dict], events: list[dict],
+                   reconfig_log: list[dict],
+                   handoff_bound_s: float) -> tuple[dict, list[str]]:
+    """The elastic-partition reconfiguration contract, from the
+    brokers' own replicated state and flight recorders. Returns (the
+    verdict `reconfig` section, its violations — first-class, alongside
+    exactly-once, which already ran unconditionally over the split
+    traffic: generation fencing changes ROUTING, never settled state).
+
+    1. time-to-rebalance is BOUNDED: no handoff window is still open at
+       the end of the run (the replicated handoff table, authoritative —
+       every begun split either cut over or timed out into cutover);
+    2. every OBSERVED begin→cutover pair completed within
+       `handoff_bound_s` (flight-recorder events, deduped across
+       brokers — every broker's metadata apply records the same
+       transition; a begin whose cutover scrolled out of the ring is
+       reported informationally, the open-handoff check above is the
+       authoritative half).
+
+    Forwarded-write and fence-refusal counters are informational
+    forensics: a schedule whose splits all landed between produce
+    bursts legitimately forwards nothing."""
+    violations: list[str] = []
+    # Dedup: every broker's apply records the same transition; keep the
+    # earliest observation of each.
+    seen: dict[tuple, dict] = {}
+    for ev in events:
+        k = (ev.get("type"), ev.get("topic"), ev.get("partition"),
+             ev.get("generation"))
+        if k not in seen or ev.get("t", 0.0) < seen[k].get("t", 0.0):
+            seen[k] = ev
+    begins = sorted((e for e in seen.values() if e["type"] == "split_begin"),
+                    key=lambda e: e.get("t", 0.0))
+    cuts = sorted((e for e in seen.values() if e["type"] == "split_cutover"),
+                  key=lambda e: e.get("t", 0.0))
+    merges = [e for e in seen.values() if e["type"] == "merge_done"]
+    durations: list[float] = []
+    unobserved: list[tuple] = []
+    for b in begins:
+        part = (b.get("topic"), b.get("partition"))
+        t_cut = next(
+            (c["t"] for c in cuts
+             if (c.get("topic"), c.get("partition")) == part
+             and c.get("t", 0.0) >= b.get("t", 0.0)),
+            None,
+        )
+        if t_cut is None:
+            unobserved.append(part)
+        else:
+            durations.append(round(t_cut - b.get("t", 0.0), 3))
+    open_now = sorted({
+        (h.get("topic"), h.get("partition"))
+        for s in rstats.values()
+        for h in (s.get("open_handoffs") or ())
+    })
+    forwarded = sum(int(s.get("forwarded_writes") or 0)
+                    for s in rstats.values())
+    fences = sum(int(s.get("fence_refusals") or 0)
+                 for s in rstats.values())
+    if not rstats:
+        violations.append(
+            "reconfig: no broker served a reconfig stats block")
+    if open_now:
+        violations.append(
+            f"reconfig: handoff window(s) still open at the end of the "
+            f"run: {open_now} — time-to-rebalance unbounded (cutover "
+            f"duty neither saw the watermark settle nor fired the "
+            f"deadline)"
+        )
+    over = [d for d in durations if d > handoff_bound_s]
+    if over:
+        violations.append(
+            f"reconfig: split handoff took {max(over)}s begin→cutover "
+            f"(> {handoff_bound_s}s bound)"
+        )
+    section = {
+        "splits_attempted": sum(1 for e in reconfig_log
+                                if e.get("op") == "split_partition"),
+        "merges_attempted": sum(1 for e in reconfig_log
+                                if e.get("op") == "merge_partitions"),
+        "splits_begun": len(begins),
+        "split_cutovers": len(cuts),
+        "merges_done": len(merges),
+        "cutover_durations_s": durations,
+        "max_cutover_s": max(durations, default=None),
+        "handoff_bound_s": handoff_bound_s,
+        "cutover_unobserved": unobserved,  # ring scrolled, not a failure
+        "open_handoffs_at_end": open_now,
+        "forwarded_writes": forwarded,
+        "fence_refusals": fences,
+        "spare_slots_left": {b: s.get("spare_slots")
+                             for b, s in rstats.items()},
+        "ops": reconfig_log,
+    }
+    return section, violations
+
+
 def run_chaos(
     seed: int,
     n_brokers: int = 3,
@@ -448,6 +596,8 @@ def run_chaos(
     slo_shed_bound_s: float = 15.0,
     slo_expect_shed: bool = False,
     follower_reads: bool = False,
+    splits: int = 0,
+    split_handoff_bound_s: float = 20.0,
 ) -> dict:
     """One seeded chaos run; returns the JSON-able verdict (see module
     docstring). Pass `schedule` (a recorded trace's fault ops grouped
@@ -523,7 +673,24 @@ def run_chaos(
     serve boundary independently of the fences under test
     (answers_past_floor). Payload safety of follower-served reads
     needs no extra machinery — they are recorded in the same history
-    the exactly-once checker already runs over."""
+    the exactly-once checker already runs over.
+
+    `splits > 0` makes the run ELASTIC (either backend): the engine is
+    sized with that many spare slots, the nemesis pool gains the
+    split_partition / merge_partitions ops (schedule-pure — they race
+    live splits and merges against whatever crashes/partitions the
+    same phase draws, controller failover included), and the producer
+    workload goes KEYED so the SDK's generation-fenced rerouting is on
+    the hot path (stale_partition_gen refusals, dual-write forwarding,
+    offset carry-over all exercised under fire). The verdict gains a
+    `reconfig` section with TWO first-class invariants (check_reconfig):
+    no handoff window still open at the end of the run, and every
+    observed begin→cutover within `split_handoff_bound_s` — bounded
+    time-to-rebalance, measured from the brokers' own replicated state
+    and flight recorders. Exactly-once runs unconditionally over the
+    split traffic: acked writes recorded against the partition the
+    broker ROUTED them into, every partition that ever existed (retired
+    children included) drained into the final logs."""
     t0 = time.time()
     topic = "chaos"
     tmp = None
@@ -556,6 +723,11 @@ def run_chaos(
         # into both backends (proc serializes it through the YAML
         # round-trip like every other field).
         slo_kw["follower_reads"] = True
+    if splits > 0:
+        # Tight handoff deadline: a split whose watermark never settles
+        # (leader crashed mid-handoff) still cuts over inside a chaos
+        # phase, comfortably under the verdict's bound.
+        slo_kw["split_handoff_timeout_s"] = 3.0
     if backend == "proc":
         from ripplemq_tpu.chaos.proc_cluster import (
             ProcCluster,
@@ -575,6 +747,7 @@ def run_chaos(
             # broker subprocesses: every produce stamps/packs through a
             # worker, controller consumes serve off the settled mirror.
             host_workers=host_workers,
+            spare_slots=splits,
             **slo_kw,
         )
         cluster = ProcCluster(config=config, data_dir=data_dir)
@@ -595,6 +768,7 @@ def run_chaos(
             group_session_timeout_s=0.8,  # see the proc branch above
             replication=replication_mode,
             host_workers=host_workers,  # see the proc branch above
+            spare_slots=splits,
         )
         cluster = InProcCluster(config, data_dir=data_dir)
     history = History()
@@ -602,14 +776,16 @@ def run_chaos(
                      "ops_per_phase": ops_per_phase, "backend": backend,
                      "replication": replication_mode,
                      "host_workers": host_workers,
-                     "follower_reads": follower_reads}
+                     "follower_reads": follower_reads,
+                     "splits": splits}
     try:
         cluster.start()
         cluster.wait_for_leaders()
         nemesis = Nemesis(cluster, seed, phases,
                           ops_per_phase=ops_per_phase, schedule=schedule,
                           backend=backend, group_members=groups,
-                          striped=(replication_mode == "striped"))
+                          striped=(replication_mode == "striped"),
+                          elastic=(splits > 0))
         # Wait for one replication standby before the first crash:
         # settled appends are then provably on a promotable peer.
         deadline = time.time() + (120 if backend == "proc" else 20)
@@ -618,7 +794,8 @@ def run_chaos(
                 break
             time.sleep(0.05)
         workload = _Workload(cluster, seed, history, topic, partitions,
-                             follower_reads=follower_reads)
+                             follower_reads=follower_reads,
+                             keyed=(splits > 0))
         workload.start()
         group_workload = None
         if groups > 0:
@@ -662,10 +839,18 @@ def run_chaos(
             workload.stop()
             if group_workload is not None:
                 group_workload.stop()
+        # Drain EVERY partition that exists at the end of the run — an
+        # elastic run's splits mint children beyond the configured
+        # count, and a retired merge child stays readable for exactly
+        # this drain (the acked-loss check looks writes up in the log
+        # they landed in, wherever routing put them).
+        final_pids = sorted({
+            a.partition_id for a in cluster.topic_view(topic)
+        } | set(range(partitions)))
         final_logs = {
             (topic, pid): _drain_partition(cluster, topic, pid,
                                            tag=f"{seed}-{pid}")
-            for pid in range(partitions)
+            for pid in final_pids
         }
         # Clean-ack exactly-once is UNCONDITIONAL: wire-dup schedules
         # are collapsed by the idempotent-producer dedup plane (client
@@ -753,6 +938,19 @@ def run_chaos(
             )
             verdict["follower"] = f_section
             violations += f_violations
+        if splits > 0:
+            # Elastic reconfiguration contract (tentpole, ISSUE 17):
+            # bounded time-to-rebalance across every split the nemesis
+            # raced against the same phase's crashes — first-class
+            # alongside exactly-once, which already covered the split
+            # traffic above.
+            r_stats, r_events = _collect_reconfig(cluster)
+            r_section, r_violations = check_reconfig(
+                r_stats, r_events, nemesis.reconfig_log,
+                handoff_bound_s=split_handoff_bound_s,
+            )
+            verdict["reconfig"] = r_section
+            violations += r_violations
         ops = history.ops()
         # Telemetry collection — while the cluster is still up. Every
         # VIOLATING verdict carries the full diagnosis (per-broker

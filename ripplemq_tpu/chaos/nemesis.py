@@ -20,6 +20,10 @@ Fault vocabulary (composing the InProcNetwork hooks, wire/transport.py):
   dup a b n      deliver the next n requests on a link twice
   kill_worker w  lockstep engine-worker kill (only when the cluster
                  runs a lockstep mesh; exercises abdication/promotion)
+  split_partition i   online split of the i-th splittable partition
+                 (elastic runs; resolved at apply time, admin.split)
+  merge_partitions i  reabsorb the i-th mergeable split child
+                 (elastic runs; resolved at apply time, admin.merge)
 
 Crash scheduling keeps a metadata majority alive (at most (n-1)//2
 concurrently crashed) — the checker tests safety under faults the
@@ -93,6 +97,24 @@ _STRIPE_OP_WEIGHTS = (
 )
 _STRIPE_OPS = tuple(n for n, _ in _STRIPE_OP_WEIGHTS)
 
+# Elastic-partition ops (runs with spare engine slots provisioned):
+# online split/merge raced against everything else in the pool —
+# controller crashes and failovers included. Schedule-pure like
+# stripe_kill: the op names a candidate INDEX, resolved at apply time
+# against the cluster's current splittable/mergeable sets through the
+# admin.split/admin.merge RPC surface (both backends); WHAT was split
+# goes to runtime forensics (reconfig_log), never the trace. An op
+# whose candidate set is empty (no spare slot, nothing mergeable) is a
+# typed-refusal no-op — also forensics, never a failure.
+_ELASTIC_OP_WEIGHTS = (
+    ("split_partition", 2),
+    ("merge_partitions", 1),
+)
+_ELASTIC_OPS = tuple(n for n, _ in _ELASTIC_OP_WEIGHTS)
+# pidx space: candidate sets are small; any fixed modulus keeps the
+# schedule pure while spreading choices across them.
+_ELASTIC_PIDX_SPACE = 8
+
 
 def make_schedule(
     seed: int,
@@ -103,15 +125,18 @@ def make_schedule(
     backend: str = "inproc",
     group_members: int = 0,
     striped: bool = False,
+    elastic: bool = False,
 ) -> list[list[dict]]:
     """Deterministic [phases][ops] fault schedule. Each phase ends with
     an implicit heal (the nemesis records it in the trace), so phases
     start from a clean network with every broker up. `backend` selects
     the op pool ("inproc": network+crash faults; "proc": SIGKILL + disk
     faults); `group_members > 0` joins the rebalance-storm ops,
-    `striped` the stripe-holder ops (sized to RS_M kills per phase) —
-    the schedule stays a pure function of (seed, roster, shape,
-    backend, group_members, striped), so any run replays byte-for-byte."""
+    `striped` the stripe-holder ops (sized to RS_M kills per phase),
+    `elastic` the online split/merge ops (both backends — they ride
+    the admin RPC surface) — the schedule stays a pure function of
+    (seed, roster, shape, backend, group_members, striped, elastic),
+    so any run replays byte-for-byte."""
     from ripplemq_tpu.stripes.codec import RS_K, RS_M
 
     rng = random.Random(seed)
@@ -125,6 +150,8 @@ def make_schedule(
             _STRIPE_OP_WEIGHTS if backend == "inproc"
             else _STRIPE_OP_WEIGHTS[:1]  # partition needs network hooks
         )
+    if elastic:
+        pool.extend(_ELASTIC_OP_WEIGHTS)
     names = [n for n, w in pool for _ in range(w)]
     max_crashed = (len(broker_ids) - 1) // 2
     schedule: list[list[dict]] = []
@@ -148,7 +175,10 @@ def make_schedule(
                 # (the holder they resolve to is a real broker down).
                 name = ("stripe_partition" if backend == "inproc"
                         else "disk_torn")
-            if name in _STRIPE_OPS:
+            if name in _ELASTIC_OPS:
+                ops.append({"op": name,
+                            "pidx": rng.randrange(_ELASTIC_PIDX_SPACE)})
+            elif name in _STRIPE_OPS:
                 if name == "stripe_kill":
                     stripe_kills += 1
                 ops.append({"op": name,
@@ -247,7 +277,8 @@ class Nemesis:
                  schedule: Optional[list[list[dict]]] = None,
                  backend: str = "inproc",
                  group_members: int = 0,
-                 striped: bool = False) -> None:
+                 striped: bool = False,
+                 elastic: bool = False) -> None:
         self.cluster = cluster
         self.seed = seed
         self.backend = backend
@@ -264,8 +295,14 @@ class Nemesis:
             backend=backend,
             group_members=group_members,
             striped=striped,
+            elastic=elastic,
         )
         self.trace: list[dict] = []
+        # Elastic-op resolution forensics: what each scheduled
+        # split/merge index resolved to and how the admin RPC answered
+        # (typed infeasible refusals included) — like disk_fault_log,
+        # informational, never part of the byte-reproducible trace.
+        self.reconfig_log: list[dict] = []
         # Disk-fault injection outcomes, parallel to the trace entries
         # that caused them (forensics; NOT part of the byte-reproducible
         # trace — what the damage hit depends on what the run persisted).
@@ -324,6 +361,9 @@ class Nemesis:
         if kind in _STRIPE_OPS:
             self._apply_stripe_op(kind, op)
             return
+        if kind in _ELASTIC_OPS:
+            self._apply_elastic_op(kind, op)
+            return
         if kind == "restart":
             b = op["broker"]
             if b in self._crashed:
@@ -377,6 +417,46 @@ class Nemesis:
             net.set_down(op["worker"])
         else:
             raise ValueError(f"unknown nemesis op {kind!r}")
+
+    def _apply_elastic_op(self, kind: str, op: dict) -> None:
+        """Resolve a split/merge index against the cluster's CURRENT
+        candidate sets and fire it through the admin RPC surface (both
+        backends; any live broker forwards the proposal). Resolution
+        and the RPC's answer go to reconfig_log forensics — the
+        schedule's purity lives in the index, like stripe ops. An
+        empty candidate set, an unreachable cluster, or a typed
+        infeasible refusal are all legitimate no-ops: the op's job is
+        to RACE reconfiguration against the rest of the pool, not to
+        guarantee one happens."""
+        i = op["pidx"]
+        entry: dict = {"op": kind, "pidx": i}
+        try:
+            if kind == "split_partition":
+                cands = sorted(
+                    (t.name, a.partition_id)
+                    for t in self.cluster.config.topics
+                    for a in self.cluster.topic_view(t.name)
+                    if a.state == "active" and a.range_hi - a.range_lo >= 2
+                )
+                if cands:
+                    topic, pid = cands[i % len(cands)]
+                    entry["resolved"] = [topic, pid]
+                    resp = self.cluster.admin_split(topic, pid)
+                    entry["resp"] = {k: resp.get(k) for k in
+                                     ("ok", "error", "child", "generation")
+                                     if k in resp}
+            else:  # merge_partitions
+                cands = sorted(self.cluster.merge_candidates())
+                if cands:
+                    topic, parent, child = cands[i % len(cands)]
+                    entry["resolved"] = [topic, parent, child]
+                    resp = self.cluster.admin_merge(topic, parent, child)
+                    entry["resp"] = {k: resp.get(k) for k in
+                                     ("ok", "error", "generation")
+                                     if k in resp}
+        except Exception as e:  # a mid-fault cluster may refuse reach
+            entry["error"] = f"{type(e).__name__}: {e}"
+        self.reconfig_log.append(entry)
 
     def _apply_stripe_op(self, kind: str, op: dict) -> None:
         """Resolve a stripe-holder op against the CURRENT replicated

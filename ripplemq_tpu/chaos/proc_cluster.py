@@ -15,7 +15,9 @@ InProcCluster implements the same names):
 
   brokers, config, start/stop, wait_for_leaders, client(name),
   kill(b) / restart(b), broker_addr(b), leader_of_key(topic, pid),
-  controller_ready(), inject_disk_fault(b, kind, salt)
+  controller_ready(), inject_disk_fault(b, kind, salt),
+  topic_view(topic), merge_candidates(), admin_split(topic, pid),
+  admin_merge(topic, parent, child)
 
 Network-layer ops (partition/drop/delay/dup) are deliberately absent —
 real kernels don't take InProcNetwork hooks; `make_schedule(backend=
@@ -62,15 +64,17 @@ def free_ports(n: int) -> list[int]:
 
 def make_proc_cluster_config(ports: list[int], topics=None,
                              durability: str = "async",
+                             spare_slots: int = 0,
                              **kw) -> ClusterConfig:
     """ClusterConfig for a localhost process cluster. Small segments so
     chaos runs actually rotate (sealed segments + RS shards are what the
     disk-fault matrix attacks); timings between the in-proc cluster's
     (too twitchy for cross-process scheduling) and production's (too
-    slow for a test budget)."""
+    slow for a test budget). `spare_slots` provisions engine partition
+    slots beyond the topic total — the pool online splits spend."""
     topics = topics or (Topic("topic1", 2, 3),)
     engine = kw.pop("engine", None) or small_engine(
-        partitions=sum(t.partitions for t in topics),
+        partitions=sum(t.partitions for t in topics) + int(spare_slots),
         replicas=max(t.replication_factor for t in topics),
         slots=256, slot_bytes=64, max_batch=16, read_batch=16,
         max_consumers=16, max_offset_updates=8,
@@ -160,6 +164,15 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "slo_settle_window_min": config.slo_settle_window_min,
         "slo_shed_occupancy": config.slo_shed_occupancy,
         "slo_quotas": {t: r for t, r in config.slo_quotas},
+        "slo_tenant_tiers": {t: v for t, v in config.slo_tenant_tiers},
+        # Elastic partitions: the trigger/hysteresis/handoff rails must
+        # round-trip or an in-proc soak and its subprocess twin run
+        # different reconfiguration behavior.
+        "split_auto": config.split_auto,
+        "split_evidence_ticks": config.split_evidence_ticks,
+        "split_merge_idle_ticks": config.split_merge_idle_ticks,
+        "split_handoff_timeout_s": config.split_handoff_timeout_s,
+        "split_max_partitions": config.split_max_partitions,
     }
 
 
@@ -355,6 +368,58 @@ class ProcCluster:
                 return tuple(int(b) for b in
                              resp.get("stripe_holders", ()))
         return ()
+
+    def topic_view(self, topic: str) -> list:
+        """Current assignment list for a topic (PartitionAssignment
+        objects, elastic surface included) over the meta.topics wire —
+        the capability InProcCluster serves from a live manager."""
+        client = self._meta_client()
+        topics = self._topics_from_any(client) or []
+        for t in topics:
+            if t.name == topic:
+                return list(t.assignments)
+        return []
+
+    def merge_candidates(self) -> list:
+        """(topic, parent, child) triples currently mergeable, derived
+        from the wire topic view (adjacent active split pairs). Open
+        handoffs are not visible here — admin.merge's pre-check refuses
+        those with a typed merge_infeasible, which the nemesis logs as
+        a no-op."""
+        out = []
+        for t in self.config.topics:
+            assigns = {a.partition_id: a for a in self.topic_view(t.name)}
+            for a in assigns.values():
+                if a.origin < 0 or a.state != "active":
+                    continue
+                p = assigns.get(a.origin)
+                if (p is not None and p.state == "active"
+                        and p.range_hi == a.range_lo):
+                    out.append((t.name, a.origin, a.partition_id))
+        return out
+
+    def admin_split(self, topic: str, pid: int) -> dict:
+        return self._admin_call({"type": "admin.split", "topic": topic,
+                                 "partition": int(pid)})
+
+    def admin_merge(self, topic: str, parent: int, child: int) -> dict:
+        return self._admin_call({"type": "admin.merge", "topic": topic,
+                                 "parent": int(parent),
+                                 "child": int(child)})
+
+    def _admin_call(self, req: dict) -> dict:
+        client = self._meta_client()
+        last: dict = {"ok": False,
+                      "error": "unavailable: no live broker reachable"}
+        for addr in self._live_addrs():
+            try:
+                last = client.call(addr, req, timeout=8.0)
+            except Exception as e:
+                last = {"ok": False,
+                        "error": f"unavailable: {type(e).__name__}: {e}"}
+                continue
+            return last
+        return last
 
     def controller_id(self) -> Optional[int]:
         client = self._meta_client()

@@ -10,13 +10,19 @@ addresses.
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import threading
 
 from ripplemq_tpu.obs.lockwitness import make_lock
 from typing import Optional
 
-from ripplemq_tpu.metadata.models import BrokerInfo, Topic, topics_from_wire
+from ripplemq_tpu.metadata.models import (
+    BrokerInfo,
+    PartitionAssignment,
+    Topic,
+    topics_from_wire,
+)
 from ripplemq_tpu.wire.retry import RetryPolicy
 from ripplemq_tpu.wire.transport import RpcError, Transport
 
@@ -176,3 +182,64 @@ class MetadataManager:
                 return None
             b = self._brokers.get(a.leader)
             return b.address if b else None
+
+    # ------------------------------------------- elastic-partition routing
+
+    def generation(self, topic: str, partition_id: int) -> Optional[int]:
+        """Cached reconfiguration generation of one partition — what a
+        keyed produce stamps as `pgen` so a post-split broker fences it
+        with `stale_partition_gen:` instead of serving stale routing."""
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return None
+            a = t.assignment_for(partition_id)
+            return a.generation if a else None
+
+    def route_key(self, topic: str, key_hash: int) -> Optional[int]:
+        """The non-retired partition whose key-hash range owns
+        `key_hash` (None when the topic is unknown) — the client half
+        of online split/merge routing."""
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return None
+            for a in t.assignments:
+                if a.state != "retired" and a.owns_key(int(key_hash)):
+                    return a.partition_id
+            return None
+
+    def adopt_routing(self, topic: str, assignments: list[dict]) -> bool:
+        """Install the routing payload a `stale_partition_gen:` refusal
+        carried, so the refused client re-resolves FROM THE REFUSAL
+        instead of spending a meta.topics round first. Generation-
+        guarded per partition: a racing refusal carrying an older
+        snapshot never regresses a fresher cache entry. Returns True
+        when anything changed."""
+        try:
+            incoming = [PartitionAssignment.from_dict(d)
+                        for d in assignments]
+        except (KeyError, ValueError, TypeError):
+            return False
+        if not incoming:
+            return False
+        with self._lock:
+            t = self._topics.get(topic)
+            if t is None:
+                return False
+            cur = {a.partition_id: a for a in t.assignments}
+            changed = False
+            for a in incoming:
+                old = cur.get(a.partition_id)
+                if old is None or a.generation > old.generation:
+                    cur[a.partition_id] = a
+                    changed = True
+            if not changed:
+                return False
+            assigns = tuple(sorted(cur.values(),
+                                   key=lambda x: x.partition_id))
+            self._topics[topic] = dataclasses.replace(
+                t, partitions=max(t.partitions, len(assigns)),
+                assignments=assigns,
+            )
+            return True

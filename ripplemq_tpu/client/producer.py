@@ -20,6 +20,7 @@ would dedupe them away).
 from __future__ import annotations
 
 import threading
+import zlib
 
 from ripplemq_tpu.obs.lockwitness import make_lock
 import time
@@ -27,6 +28,7 @@ import uuid
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
+from ripplemq_tpu.metadata.models import RANGE_SPACE
 from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
 from ripplemq_tpu.wire.retry import RetryPolicy, fatal_response_error
 from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
@@ -34,6 +36,13 @@ from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
 
 class ProduceError(Exception):
     pass
+
+
+def key_hash(key: bytes) -> int:
+    """Deterministic key→range-space hash (crc32 mod RANGE_SPACE):
+    stable across processes and runs, so the chaos checker can replay
+    a keyed workload's routing decisions exactly."""
+    return zlib.crc32(bytes(key)) % RANGE_SPACE
 
 
 class ProducerClient:
@@ -63,6 +72,13 @@ class ProducerClient:
         self._idempotence = bool(idempotence)
         self._pid: Optional[int] = None
         self._pid_name = producer_name or f"producer/{uuid.uuid4().hex}"
+        # Partition the LAST acked produce_batch landed in (the broker
+        # names `routed_partition` when it forwarded a migrating-range
+        # write during a split handoff; otherwise the pinned choice).
+        # Chaos/bench callers read this to attribute each ack to the
+        # right final log. Single-threaded-per-producer contract, like
+        # the sequence counters.
+        self.last_partition: Optional[int] = None
         # Session refresh: re-register (idempotent; the apply bumps the
         # replicated seen counter) at this cadence so the metadata
         # leader's pid reaper sees a live session. Keep it well under
@@ -93,12 +109,15 @@ class ProducerClient:
     # ------------------------------------------------------------------ API
 
     def produce(self, topic: str, message: bytes,
-                partition: Optional[int] = None) -> int:
+                partition: Optional[int] = None,
+                key: Optional[bytes] = None) -> int:
         """Send one message; returns its assigned absolute offset."""
-        return self.produce_batch(topic, [message], partition=partition)
+        return self.produce_batch(topic, [message], partition=partition,
+                                  key=key)
 
     def produce_batch(self, topic: str, messages: list[bytes],
-                      partition: Optional[int] = None) -> int:
+                      partition: Optional[int] = None,
+                      key: Optional[bytes] = None) -> int:
         """Send a batch to ONE partition; returns the first assigned
         offset. The batch rides a single RPC and as few device rounds as
         its size requires (vs. the reference's one message per RPC,
@@ -110,11 +129,22 @@ class ProducerClient:
         acked as a duplicate by the broker's dedup table — the window
         that used to make retried produces at-least-once. The sequence
         range is reserved the first time it goes on the wire; a call
-        abandoned after that burns its range (see module docstring)."""
+        abandoned after that burns its range (see module docstring).
+
+        With a `key`, the partition is resolved by KEY-HASH RANGE
+        (elastic partitions): the request carries `key_hash` plus the
+        resolver's `pgen` generation stamp, so a broker whose topology
+        moved on fences it with `stale_partition_gen:` — this loop then
+        re-resolves from the refusal's routing payload and retries
+        under the new generation. A reroute reserves a FRESH sequence
+        range (the new partition is a different log; the old range is
+        burnt), so a reroute straddling an unknown-outcome attempt is
+        at-least-once — exactly the retried-ack contract, never worse."""
         if not messages:
             raise ValueError("empty batch")
         run = self._retry.begin()
         pin = partition
+        khash = None if key is None else key_hash(key)
         pid = seq = None
         n = len(messages)
         while run.attempt():
@@ -123,6 +153,16 @@ class ProducerClient:
                 run.note(f"unknown topic {topic!r}")
                 self._refresh_quietly()
                 continue
+            if khash is not None and partition is None:
+                # Keyed routing re-resolves per attempt: an adopted
+                # stale_partition_gen payload (or a background refresh)
+                # moves the pin to the range's CURRENT owner; the dedup
+                # identity is re-reserved on reroute below.
+                owner = self._meta.route_key(topic, khash)
+                if owner is not None and owner != pin:
+                    if pin is not None:
+                        seq = None  # different log: fresh identity
+                    pin = owner
             if pin is None:
                 # One selector advance per CALL (not per attempt): a
                 # retry must replay the same partition, or the dedup
@@ -136,8 +176,8 @@ class ProducerClient:
                 continue
             if self._idempotence and pid is None:
                 pid = self._ensure_pid(addr, run)
-                if pid is not None:
-                    seq = self._reserve_seq(topic, pin, n)
+            if pid is not None and seq is None:
+                seq = self._reserve_seq(topic, pin, n)
             # The producer NAME rides every request (pid or not): its
             # prefix before the first "/" is the tenant key the broker's
             # SLO admission controller meters (slo/admission.py) — an
@@ -148,6 +188,11 @@ class ProducerClient:
                    "messages": list(messages), "producer": self._pid_name}
             if pid is not None:
                 req["pid"], req["seq"] = pid, seq
+            if khash is not None:
+                req["key_hash"] = khash
+                gen = self._meta.generation(topic, pin)
+                if gen is not None:
+                    req["pgen"] = gen
             try:
                 resp = self._transport.call(
                     addr, req, timeout=run.clip(self._timeout),
@@ -157,6 +202,7 @@ class ProducerClient:
                 self._refresh_quietly()
                 continue
             if resp.get("ok"):
+                self.last_partition = int(resp.get("routed_partition", pin))
                 return int(resp["base_offset"])
             err = str(resp.get("error", ""))
             run.note(err)
@@ -164,6 +210,14 @@ class ProducerClient:
                 # Follow the hint next attempt via a metadata refresh; the
                 # hint's addr is also directly usable when present.
                 self._refresh_quietly()
+                continue
+            if err.startswith("stale_partition_gen:"):
+                # Generation fence: re-resolve from the refusal's
+                # routing payload (no metadata round) — the next
+                # attempt re-routes at the top of the loop.
+                if not self._meta.adopt_routing(
+                        topic, resp.get("routing") or []):
+                    self._refresh_quietly()
                 continue
             if fatal_response_error(err):
                 raise ProduceError(err)  # terminal

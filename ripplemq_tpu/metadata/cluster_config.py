@@ -253,6 +253,40 @@ class ClusterConfig:
     # is refused with the retryable `overloaded:` error. YAML:
     # `slo_quotas: {tenant: rate, ...}`.
     slo_quotas: tuple = ()
+    # Per-tenant priority tiers for the shed LADDER: ((tenant, tier),
+    # ...), tier in {"high", "low"}. Shedding degrades in steps —
+    # best-effort (unquoted) traffic is refused the moment the shed
+    # machine engages; "low"-tier QUOTA HOLDERS are refused only after
+    # the shed persists (escalation, slo/admission.py); "high"-tier
+    # tenants keep admission up to their buckets through both steps.
+    # Tenants absent from this table default to "high" (the pre-tier
+    # behavior: every quota holder rode out a shed). YAML:
+    # `slo_tenant_tiers: {tenant: high|low, ...}`.
+    slo_tenant_tiers: tuple = ()
+    # --- Elastic partitions (broker/manager.py split/merge) -------------
+    # SLO-driven reconfiguration trigger: when true, the controller
+    # broker's SLO tick history arms an online split of the hottest
+    # partition after `split_evidence_ticks` breach-evidencing ticks,
+    # and proposes the reverse merge after `split_merge_idle_ticks`
+    # consecutive comfortable ticks (hysteresis like the shed machine).
+    # Splits spend SPARE engine slots (engine.partitions beyond the
+    # configured topic total); with none left the proposal no-ops.
+    # False (default): splits/merges happen only via admin.split /
+    # admin.merge.
+    split_auto: bool = False
+    split_evidence_ticks: int = 4
+    split_merge_idle_ticks: int = 64
+    # Handoff bound: a split's dual-write window is closed (cutover
+    # proposed) at the latest this many seconds after the controller's
+    # reconfig duty first sees it, even if the parent's settled floor
+    # has not provably reached the split-begin watermark — a bounded
+    # time-to-rebalance beats an unbounded dual-write window (the
+    # watermark gate is the normal path; the timeout is the escape
+    # hatch a wedged settle pipe would otherwise hold open forever).
+    split_handoff_timeout_s: float = 10.0
+    # Cap on any topic's TOTAL partition count (configured + split
+    # children, retired included). 0 = no cap beyond engine capacity.
+    split_max_partitions: int = 0
 
     def __post_init__(self) -> None:
         if self.durability not in ("async", "strict"):
@@ -353,6 +387,35 @@ class ClusterConfig:
                     f"slo_quotas rate for {tenant!r} must be > 0, "
                     f"got {rate!r}"
                 )
+        tiers_seen = set()
+        for entry in self.slo_tenant_tiers:
+            tenant, tier = entry
+            if not isinstance(tenant, str) or not tenant:
+                raise ValueError(
+                    f"slo_tenant_tiers tenant must be a non-empty string, "
+                    f"got {tenant!r}"
+                )
+            if tier not in ("high", "low"):
+                raise ValueError(
+                    f"slo_tenant_tiers tier for {tenant!r} must be "
+                    f"'high' or 'low', got {tier!r}"
+                )
+            tiers_seen.add(tenant)
+        if self.split_evidence_ticks < 1:
+            raise ValueError("split_evidence_ticks must be >= 1")
+        if self.split_merge_idle_ticks < 1:
+            raise ValueError("split_merge_idle_ticks must be >= 1")
+        if self.split_handoff_timeout_s <= 0:
+            raise ValueError("split_handoff_timeout_s must be > 0")
+        if self.split_max_partitions < 0:
+            raise ValueError(
+                "split_max_partitions must be >= 0 (0 = engine capacity)"
+            )
+        if self.split_auto and self.slo_p99_ack_ms <= 0:
+            raise ValueError(
+                "split_auto requires slo_p99_ack_ms > 0: the split "
+                "trigger arms off the SLO controller's tick history"
+            )
         if self.follower_page_cache_bytes < (1 << 20):
             raise ValueError(
                 f"follower_page_cache_bytes="
@@ -518,6 +581,22 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["slo_quotas"] = tuple(
             sorted((str(t), float(r)) for t, r in dict(q).items())
         )
+    if "slo_tenant_tiers" in raw:
+        tiers = raw["slo_tenant_tiers"] or {}
+        extra["slo_tenant_tiers"] = tuple(
+            sorted((str(t), str(v)) for t, v in dict(tiers).items())
+        )
+    if "split_auto" in raw:
+        extra["split_auto"] = bool(raw["split_auto"])
+    if "split_evidence_ticks" in raw:
+        extra["split_evidence_ticks"] = int(raw["split_evidence_ticks"])
+    if "split_merge_idle_ticks" in raw:
+        extra["split_merge_idle_ticks"] = int(raw["split_merge_idle_ticks"])
+    if "split_handoff_timeout_s" in raw:
+        extra["split_handoff_timeout_s"] = float(
+            raw["split_handoff_timeout_s"])
+    if "split_max_partitions" in raw:
+        extra["split_max_partitions"] = int(raw["split_max_partitions"])
     if "coalesce_s" in raw:
         extra["coalesce_s"] = float(raw["coalesce_s"])
     if "read_coalesce_s" in raw:

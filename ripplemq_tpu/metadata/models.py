@@ -23,6 +23,14 @@ from typing import Optional
 
 GroupKey = tuple[str, int]
 
+# Key-hash routing space: every partition owns a half-open range of
+# [0, RANGE_SPACE). A split carves one range at its midpoint; a merge
+# reabsorbs the child's range into the parent. 2^16 is wide enough that
+# log2(RANGE_SPACE) successive splits of one partition never degenerate
+# to an empty range, and narrow enough that range bounds stay small
+# wire integers.
+RANGE_SPACE = 1 << 16
+
 
 def group_key(topic: str, partition_id: int) -> GroupKey:
     """Canonical identity of one topic-partition replication group."""
@@ -65,12 +73,38 @@ class PartitionAssignment:
     reference (PartitionManager.java:200-275). `term` is the partition's
     replication term, bumped on every leader change (the engine stamps log
     entries with it; the reference leaves terms inside JRaft).
+
+    Elastic-partition surface (all wire-defaulted so pre-split metadata
+    round-trips unchanged):
+
+    - `generation`: the partition's reconfiguration epoch — bumped by
+      every split/merge transition that touches this partition. A
+      request stamped with an older generation draws the typed
+      retryable `stale_partition_gen:` refusal (the groups plane's
+      fencing discipline reapplied to partitions).
+    - `range_lo`/`range_hi`: the half-open key-hash range this
+      partition owns in [0, RANGE_SPACE). A split halves it; the merge
+      reabsorbs it.
+    - `state`: "active" | "handoff" (split begun, cutover pending —
+      the parent dual-writes migrated-range traffic to the child) |
+      "retired" (merged child: produces refused with routing to the
+      parent, log stays readable for draining).
+    - `origin`: the parent partition id for split children (-1 for
+      configured partitions) — what the merge planner pairs on.
     """
 
     partition_id: int
     replicas: tuple[int, ...]          # broker ids, stable order
     leader: Optional[int] = None
     term: int = 0
+    generation: int = 0
+    range_lo: int = 0
+    range_hi: int = RANGE_SPACE
+    state: str = "active"
+    origin: int = -1
+
+    def owns_key(self, key_hash: int) -> bool:
+        return self.range_lo <= (key_hash % RANGE_SPACE) < self.range_hi
 
     def to_dict(self) -> dict:
         return {
@@ -78,6 +112,11 @@ class PartitionAssignment:
             "replicas": list(self.replicas),
             "leader": self.leader,
             "term": self.term,
+            "generation": self.generation,
+            "range_lo": self.range_lo,
+            "range_hi": self.range_hi,
+            "state": self.state,
+            "origin": self.origin,
         }
 
     @staticmethod
@@ -88,6 +127,11 @@ class PartitionAssignment:
             tuple(int(r) for r in d["replicas"]),
             None if leader is None else int(leader),
             int(d.get("term", 0)),
+            int(d.get("generation", 0)),
+            int(d.get("range_lo", 0)),
+            int(d.get("range_hi", RANGE_SPACE)),
+            str(d.get("state", "active")),
+            int(d.get("origin", -1)),
         )
 
 
@@ -140,10 +184,18 @@ def placement_only(topics: list[Topic] | tuple[Topic, ...]) -> list[Topic]:
     it, regressing the advertised term below the device current_term
     (the permanent write wedge the chaos plane caught, PR 4). The
     (leader, term) surface is owned entirely by OP_SET_LEADER; applies
-    source it from the replicated current table."""
+    source it from the replicated current table. The elastic surface
+    (generation/range/state/origin) is stripped for the same reason —
+    it is owned by the split/merge applies, and a placement snapshot
+    taken before a split must not regress the generation when it
+    lands after."""
     return [
         t.with_assignments(tuple(
-            dataclasses.replace(a, leader=None, term=0)
+            dataclasses.replace(
+                a, leader=None, term=0, generation=0,
+                range_lo=0, range_hi=RANGE_SPACE, state="active",
+                origin=-1,
+            )
             for a in t.assignments
         ))
         for t in topics

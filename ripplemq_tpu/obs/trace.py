@@ -59,9 +59,16 @@ EVENT_TYPES = frozenset({
     # adjustment (the control timeline postmortems replay) and the
     # load-shedding state machine's transitions.
     "slo_adjust", "slo_shed_on", "slo_shed_off",
+    # Shed-LADDER intermediate move (level 1↔2, slo/controller.py): the
+    # shed stayed on but its tier bite escalated or stepped down.
+    "slo_shed_level",
     # Follower reads (broker/server.py): the metadata leader committed
     # a follower-read lease table for the current controller epoch.
     "follower_lease",
+    # Elastic partitions (broker/manager.py applies): a split opened
+    # its dual-write handoff window, the reconfig duty closed it at
+    # the settled watermark, a merge reabsorbed a child's range.
+    "split_begin", "split_cutover", "merge_done",
 })
 
 

@@ -17,6 +17,24 @@ raw produces — is refused). Refusals carry the typed retryable
 `overloaded:` prefix so clients jitter-backoff-and-retry instead of
 hammering the refusal path (wire/retry.py).
 
+The shed gate is a LADDER, not a switch (`slo_tenant_tiers`):
+
+- **Level 0** — steady state. Quota caps only.
+- **Level 1** — shed engaged. Best-effort traffic (tenants holding
+  neither a quota nor a tier entry, including anonymous produces) is
+  refused; every tiered/quota-holding tenant still admits through its
+  bucket.
+- **Level 2** — escalation (the controller holds the shed through
+  `ESCALATE_STREAK` more evidencing ticks, slo/controller.py).
+  "low"-tier tenants are refused too; only "high"-tier tenants keep
+  admission, up to their buckets.
+
+Tenants absent from the tier table default to "high" — the exact
+pre-tier behavior, where every quota holder rode out a shed. The
+ladder exists so a broker under sustained overload keeps degrading
+in priority order instead of choosing between "refuse nobody with a
+quota" and "refuse everybody".
+
 Quotas are enforced PER BROKER: a tenant's effective cluster rate is
 its quota times the partition-leader brokers it produces to, the same
 per-serving-node semantics as every broker-local limiter (documented
@@ -73,12 +91,14 @@ class AdmissionController:
     nothing to say."""
 
     def __init__(self, quotas: dict[str, float],
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 tiers: Optional[dict[str, str]] = None) -> None:
         self._clock = clock
         self._lock = make_lock("AdmissionController._lock")
         self._quotas = {str(k): float(v) for k, v in dict(quotas or {}).items()}
+        self._tiers = {str(k): str(v) for k, v in dict(tiers or {}).items()}
         self._buckets: dict[str, TokenBucket] = {}
-        self._shed = False
+        self._shed_level = 0
         # Counters (racy-read snapshot contract, like obs.metrics):
         # written under _lock, read bare by stats().
         self.shed_refusals = 0
@@ -86,11 +106,28 @@ class AdmissionController:
 
     @property
     def shedding(self) -> bool:
-        return self._shed
+        return self._shed_level > 0
+
+    @property
+    def shed_level(self) -> int:
+        return self._shed_level
 
     def set_shed(self, on: bool) -> None:
+        """Switch-shaped compatibility surface: on = ladder level 1."""
+        self.set_shed_level(1 if on else 0)
+
+    def set_shed_level(self, level: int) -> None:
         with self._lock:
-            self._shed = bool(on)
+            self._shed_level = max(0, min(2, int(level)))
+
+    def tier_of(self, tenant: str) -> str:
+        """"high" / "low" / "best_effort". A tenant holding a quota or a
+        tier entry is prioritized; an explicit tier wins; a quota holder
+        with no tier entry defaults to "high" (pre-ladder behavior)."""
+        t = self._tiers.get(tenant)
+        if t is not None:
+            return t
+        return "high" if tenant in self._quotas else "best_effort"
 
     @staticmethod
     def tenant_of(producer_name: Optional[str]) -> str:
@@ -103,17 +140,22 @@ class AdmissionController:
     def admit(self, producer_name: Optional[str], n: int) -> Optional[str]:
         """None = admitted. A string = refusal reason (the caller emits
         it under the retryable `overloaded:` prefix)."""
-        if not self._shed and not self._quotas:
+        if self._shed_level == 0 and not self._quotas:
             return None  # autopilot quiet: zero-cost front door
         tenant = self.tenant_of(producer_name)
         with self._lock:
+            level = self._shed_level
+            if level > 0:
+                tier = self.tier_of(tenant)
+                if tier == "best_effort" or (level >= 2 and tier == "low"):
+                    self.shed_refusals += 1
+                    what = ("best-effort" if tier == "best_effort"
+                            else "'low'-tier")
+                    return (f"shedding {what} traffic (tenant "
+                            f"{tenant or '<anonymous>'!r}, shed level "
+                            f"{level}); retry with backoff")
             rate = self._quotas.get(tenant)
             if rate is None:
-                if self._shed:
-                    self.shed_refusals += 1
-                    return (f"shedding best-effort traffic (tenant "
-                            f"{tenant or '<anonymous>'!r} holds no quota); "
-                            f"retry with backoff")
                 return None
             b = self._buckets.get(tenant)
             if b is None:
@@ -126,8 +168,10 @@ class AdmissionController:
 
     def stats(self) -> dict:
         return {
-            "shedding": self._shed,
+            "shedding": self._shed_level > 0,
+            "shed_level": self._shed_level,
             "quota_tenants": len(self._quotas),
+            "tier_tenants": len(self._tiers),
             "shed_refusals": self.shed_refusals,
             "quota_refusals": self.quota_refusals,
         }

@@ -93,6 +93,13 @@ log = get_logger("slo")
 EVIDENCE_WINDOW = 5
 EVIDENCE_MIN = 2
 CLEAR_STREAK = 3
+# Shed-LADDER escalation: after the shed engages (level 1, best-effort
+# refused), this many FURTHER evidencing ticks escalate to level 2
+# ("low"-tier quota holders refused too, slo/admission.py). Recovery
+# walks back down the same ladder one level per CLEAR_STREAK — the
+# hysteresis applies per step, so a marginal recovery re-admits the low
+# tier without flapping best-effort admission.
+ESCALATE_STREAK = 3
 # Minimum ack samples in a tick window before its p99 drives an AIMD
 # knob move (a single straggler must not halve the knobs). The shed
 # machine and the recovery contract use ANY-sample windows instead:
@@ -135,7 +142,15 @@ class SloController:
         self.sw_min = int(config.slo_settle_window_min)
         self.shed_occupancy = float(config.slo_shed_occupancy)
         self.admission = AdmissionController(
-            dict(config.slo_quotas), clock=clock)
+            dict(config.slo_quotas), clock=clock,
+            tiers=dict(config.slo_tenant_tiers))
+        # Elastic-partition trigger thresholds (broker duty loop reads
+        # split_wanted()/merge_wanted(); the controller only ACCUMULATES
+        # evidence — proposing a reconfiguration is the broker's job,
+        # where the metadata propose path and the engine live).
+        self.split_auto = bool(config.split_auto)
+        self.split_evidence_ticks = int(config.split_evidence_ticks)
+        self.split_merge_idle_ticks = int(config.split_merge_idle_ticks)
         self._metrics = metrics
         self._recorder = recorder
         self._dataplane_fn = dataplane_fn
@@ -154,9 +169,16 @@ class SloController:
         self._lock = make_lock("SloController._lock")
         # --- state under _lock ---
         self._shed = False
+        self._shed_level = 0
+        self._breach_streak = 0  # evidencing ticks while already shedding
         self._shed_count = 0
         self._adjusts = 0
         self._ticks = 0
+        # Split/merge evidence runs: consecutive breach ticks arm a
+        # split; consecutive comfortable-or-idle ticks arm the reverse
+        # merge (hysteresis — split_merge_idle_ticks defaults deep).
+        self._breach_run = 0
+        self._calm_run = 0
         # Per-signal evidence rings: 1 per tick the signal evidenced,
         # trimmed to EVIDENCE_WINDOW (see the module constants).
         self._occ_ev: list[int] = []
@@ -288,6 +310,16 @@ class SloController:
             self._ticks += 1
             self._last_p99_ms = p99_ms
             self._last_ok = ok
+            # Split/merge evidence: a measured breach tick extends the
+            # split run; ANY other tick (meeting the target, or no data
+            # at all — an idle partition is the merge candidate by
+            # definition) extends the calm run and breaks the breach.
+            if ok is False:
+                self._breach_run += 1
+                self._calm_run = 0
+            else:
+                self._breach_run = 0
+                self._calm_run += 1
             self._last_consume_p99_ms = c_p99_ms
             self._last_consume_ok = c_ok
             for ring, hit in ((self._occ_ev, occ_hit),
@@ -304,21 +336,41 @@ class SloController:
             if sum(self._fail_ev) >= EVIDENCE_MIN:
                 reasons.append("settle_failures")
             self._last_reasons = reasons
+            level_before = self._shed_level
             if reasons:
                 self._clear_streak = 0
                 if not self._shed:
                     self._shed = True
+                    self._shed_level = 1
+                    self._breach_streak = 0
                     self._shed_count += 1
                     turn_on_reasons = reasons
                     self._transitions.append([t, 1.0])
                     del self._transitions[:-TRANSITION_RING]
+                else:
+                    # Ladder escalation: a shed that HOLDS through more
+                    # evidencing ticks refuses the low tier too.
+                    self._breach_streak += 1
+                    if (self._shed_level == 1
+                            and self._breach_streak >= ESCALATE_STREAK):
+                        self._shed_level = 2
+                        self._breach_streak = 0
             else:
                 self._clear_streak += 1
+                self._breach_streak = 0
                 if self._shed and self._clear_streak >= CLEAR_STREAK:
-                    self._shed = False
-                    turn_off = True
-                    self._transitions.append([t, 0.0])
-                    del self._transitions[:-TRANSITION_RING]
+                    # One ladder step per earned streak: level 2 first
+                    # re-admits the low tier, THEN a fresh streak ends
+                    # the shed entirely.
+                    self._shed_level -= 1
+                    self._clear_streak = 0
+                    if self._shed_level <= 0:
+                        self._shed = False
+                        self._shed_level = 0
+                        turn_off = True
+                        self._transitions.append([t, 0.0])
+                        del self._transitions[:-TRANSITION_RING]
+            level_now = self._shed_level
             shed_now = self._shed
             self._tick_ring.append([
                 t,
@@ -329,21 +381,33 @@ class SloController:
             del self._tick_ring[:-TICK_RING]
         # Transitions act OUTSIDE the controller lock (admission has
         # its own mutex; the recorder is lock-free).
+        if level_now != level_before:
+            self.admission.set_shed_level(level_now)
         if turn_on_reasons is not None:
-            self.admission.set_shed(True)
             self._recorder.record(
                 "slo_shed_on", reason=",".join(turn_on_reasons),
+                level=level_now,
                 p99_ms=-1.0 if p99_ms is None else round(p99_ms, 3),
             )
             log.warning("slo: load shedding ON (%s; p99=%s ms)",
                         ",".join(turn_on_reasons), p99_ms)
         elif turn_off:
-            self.admission.set_shed(False)
             self._recorder.record(
                 "slo_shed_off",
                 p99_ms=-1.0 if p99_ms is None else round(p99_ms, 3),
             )
             log.info("slo: load shedding OFF (p99=%s ms)", p99_ms)
+        elif level_now != level_before:
+            # Intermediate ladder move (1→2 escalation, 2→1 step-down):
+            # the shed stays on, only its tier bite changed.
+            self._recorder.record(
+                "slo_shed_level", level=level_now,
+                reason=",".join(reasons) if reasons else "clear_streak",
+                p99_ms=-1.0 if p99_ms is None else round(p99_ms, 3),
+            )
+            log.warning("slo: shed level %d -> %d (%s)",
+                        level_before, level_now,
+                        ",".join(reasons) or "clear_streak")
 
         applied = None
         if dp is not None and knobs is not None and ok is not None \
@@ -432,6 +496,34 @@ class SloController:
         )
         return applied
 
+    # ----------------------------------------------- elastic-partition arm
+
+    def split_wanted(self) -> bool:
+        """True when `split_auto` is on and the produce SLO has breached
+        for `split_evidence_ticks` CONSECUTIVE measured ticks — the
+        broker's reconfig duty then proposes a split of the hottest
+        partition and calls note_reconfig()."""
+        with self._lock:
+            return (self.split_auto
+                    and self._breach_run >= self.split_evidence_ticks)
+
+    def merge_wanted(self) -> bool:
+        """True when `split_auto` is on and the cluster has been
+        comfortable or idle for `split_merge_idle_ticks` consecutive
+        ticks — deep hysteresis, so a load lull between bursts does not
+        merge what the next burst would immediately re-split."""
+        with self._lock:
+            return (self.split_auto
+                    and self._calm_run >= self.split_merge_idle_ticks)
+
+    def note_reconfig(self) -> None:
+        """A split/merge was just proposed off this controller's
+        evidence: restart both runs so one sustained breach arms exactly
+        one reconfiguration, not one per duty pass."""
+        with self._lock:
+            self._breach_run = 0
+            self._calm_run = 0
+
     # ------------------------------------------------------------ surface
 
     def stats(self) -> dict:
@@ -455,7 +547,11 @@ class SloController:
                 "ticks": self._ticks,
                 "adjustments": self._adjusts,
                 "shed_count": self._shed_count,
+                "shed_level": self._shed_level,
                 "shed_reasons": list(self._last_reasons),
+                "split_auto": self.split_auto,
+                "breach_run": self._breach_run,
+                "calm_run": self._calm_run,
                 "admission": self.admission.stats(),
                 "knobs": knobs,
                 "transitions": [list(x) for x in self._transitions],

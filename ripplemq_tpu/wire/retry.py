@@ -76,6 +76,13 @@ FATAL_ERROR_PREFIXES = (
     # broken seq until the plane is rebuilt — re-sending is a tight
     # error loop, not a recovery.
     "lockstep break",
+    # Elastic-partition admin pre-checks (broker/server.py): the split
+    # or merge is structurally impossible RIGHT NOW for the named
+    # partition(s) — no spare slot, range too narrow, pair no longer
+    # adjacent. Re-proposing the identical op cannot change that; the
+    # operator/nemesis re-plans against fresh topology instead.
+    "split_infeasible",
+    "merge_infeasible",
 )
 
 # Known-retryable prefixes (transient by construction). This tuple is
@@ -117,6 +124,15 @@ RETRYABLE_ERROR_PREFIXES = (
     # so "later" genuinely heals it. Never fatal: refusing instead of
     # serving is exactly the safety contract.
     "not_settled_here",
+    # Elastic-partition generation fence (broker/server.py): the
+    # sender's routing was resolved under an older partition
+    # generation — a split/merge has re-carved the key ranges since.
+    # RETRYABLE, but not blindly: the refusal carries the topic's
+    # current assignments (`routing`), and the SDKs re-resolve from
+    # that payload before the retry, so the next attempt lands under
+    # the new generation instead of hammering the fence (the
+    # fenced_generation lesson, applied to partitions).
+    "stale_partition_gen",
     "internal",             # unexpected exception; timing-dependent
 )
 

@@ -352,6 +352,61 @@ def test_admission_quota_and_shed_tiers():
     assert st["shed_refusals"] >= 2 and st["quota_refusals"] >= 1
 
 
+def test_shed_ladder_tiers_keep_high_tenant_admitted():
+    """Directed ladder walk (slo_tenant_tiers): the shed gate refuses
+    tier by tier, and a "high"-tier tenant stays admitted at EVERY
+    level — shedding protects paying traffic, it never rations it.
+    Level 1 drops best-effort only; level 2 also drops "low"; "high"
+    (explicit, or implied by holding a quota) rides through both."""
+    clock = FakeClock()
+    adm = AdmissionController(
+        {"gold": 100.0},
+        clock=clock.time,
+        tiers={"gold": "high", "bronze": "low"},
+    )
+    assert adm.tier_of("gold") == "high"
+    assert adm.tier_of("bronze") == "low"
+    assert adm.tier_of("anon") == "best_effort"
+
+    # Level 0: everyone in.
+    for name in ("gold/p", "bronze/p", "anon/p", None):
+        assert adm.admit(name, 1) is None
+
+    # Level 1: best-effort out, both prioritized tiers still in.
+    adm.set_shed_level(1)
+    refusal = adm.admit("anon/p", 1)
+    assert refusal is not None and "best-effort" in refusal
+    assert adm.admit(None, 1) is not None  # anonymous = best-effort
+    assert adm.admit("bronze/p", 1) is None
+    assert adm.admit("gold/p", 1) is None
+
+    # Level 2: "low" out too — with its OWN refusal reason, so a shed
+    # bronze tenant can tell rationing from a broker that lost its
+    # quota config. "high" still admitted (quota permitting).
+    adm.set_shed_level(2)
+    refusal = adm.admit("bronze/p", 1)
+    assert refusal is not None and "'low'-tier" in refusal
+    assert "best-effort" not in refusal
+    assert adm.admit("gold/p", 1) is None
+
+    # The quota still bills the protected tier: high-priority is not
+    # unmetered, it is just never shed.
+    clock.advance(1.0)
+    assert adm.admit("gold/p", 200) is None          # debt-admitted
+    quota_refusal = adm.admit("gold/p", 1)
+    assert quota_refusal is not None and "quota" in quota_refusal
+
+    # Ladder down: level 1 re-admits bronze, level 0 re-admits all.
+    adm.set_shed_level(1)
+    assert adm.admit("bronze/p", 1) is None
+    adm.set_shed_level(0)
+    assert adm.admit("anon/p", 1) is None
+    st = adm.stats()
+    assert st["tier_tenants"] == 2
+    assert st["shed_level"] == 0 and not st["shedding"]
+    assert st["shed_refusals"] >= 3 and st["quota_refusals"] >= 1
+
+
 def test_overloaded_is_retryable_and_producer_backs_off():
     """The client half of the shed contract: `overloaded:` is in the
     retryable taxonomy, and the producer retries it through its
