@@ -1522,6 +1522,251 @@ def _run_group_consume(n_groups: int = 3, members: int = 2,
         }
 
 
+def _run_control_plane_storm(
+    shapes: tuple[tuple[int, int, int], ...] = (
+        (10, 10, 4),      # 100 members — also run as the direct baseline
+        (40, 10, 8),      # 400 members
+        (100, 10, 16),    # 1000 members / 100 groups — the headline shape
+    ),
+    churn_rounds: int = 2,
+    churn_frac: float = 0.2,
+    beat_window_s: float = 1.5,
+) -> dict:
+    """Control-plane volume sweep (ISSUE 18): group count x churn rate x
+    tenant count, driving the membership RPC surface directly (the data
+    plane is irrelevant here — no payloads move). Each shape storms
+    `groups x members` group.join RPCs plus `tenants` producer.register
+    RPCs through a thread pool, then `churn_rounds` rounds of
+    leave+rejoin over `churn_frac` of the membership, then a fixed
+    heartbeat window with every member beating.
+
+    Reported per shape (read from the brokers' admin.stats
+    `control_plane` block — the same counters operators see):
+
+    - raft proposals per membership EVENT: with wave batching every
+      coalesced OP_BATCH is ONE proposal carrying many events; the
+      collapse factor (events/proposals) is the tentpole claim (>= 20x
+      at the 1000-member shape). The direct arm (meta_batch_s=0, the
+      pre-wave path) is 1 proposal/event BY CONSTRUCTION — measured on
+      the smallest shape to keep the bench bounded.
+    - leader heartbeat RPCs/s BEFORE vs AFTER: before = the measured
+      member beat arrival rate (every one of which the old path
+      forwarded to the metadata leader); after = the measured
+      group.beats frame ingest rate at the leader (O(brokers) per
+      relay interval, heartbeat_relay_s).
+    - convergence p50/p99: per membership event, the RPC round-trip
+      until the proposing broker serves the new replicated state (wave
+      wait + raft commit + local apply — the latency a joining member
+      actually experiences)."""
+    import queue as _queue
+    import random
+    import threading as _threading
+
+    from ripplemq_tpu.chaos.cluster import InProcCluster, make_cluster_config
+    from ripplemq_tpu.metadata.models import Topic
+
+    partitions = 8
+
+    def one_arm(groups: int, members: int, tenants: int,
+                meta_batch_s: float) -> dict:
+        config = make_cluster_config(
+            3, topics=(Topic("storm", partitions, 3),), engine=None,
+            rpc_timeout_s=10.0,
+            # Nobody beats during the join/churn storm: keep sessions
+            # from lapsing so no eviction waves pollute the counters.
+            group_session_timeout_s=30.0,
+            meta_batch_s=meta_batch_s,
+        )
+        with InProcCluster(config) as cluster:
+            cluster.wait_for_leaders()
+            addrs = [b.address for b in config.brokers]
+            n_workers = min(128, groups * members)
+            clients = [cluster.client(f"storm-w{w}")
+                       for w in range(n_workers)]
+            lat_ms: list[float] = []
+            lat_lock = _threading.Lock()
+            work: _queue.Queue = _queue.Queue()
+            errs: list[str] = []
+
+            def worker(w: int):
+                while True:
+                    req = work.get()
+                    if req is None:
+                        work.task_done()
+                        return
+                    t0 = time.perf_counter()
+                    try:
+                        resp = clients[w].call(addrs[w % len(addrs)],
+                                               req, timeout=15.0)
+                        if resp.get("ok"):
+                            with lat_lock:
+                                lat_ms.append(
+                                    (time.perf_counter() - t0) * 1e3)
+                        else:
+                            errs.append(str(resp.get("error")))
+                    except Exception as e:
+                        errs.append(f"{type(e).__name__}: {e}")
+                    finally:
+                        work.task_done()
+
+            threads = [_threading.Thread(target=worker, args=(w,),
+                                         daemon=True)
+                       for w in range(n_workers)]
+            for t in threads:
+                t.start()
+
+            def run_events(events: list[dict]) -> None:
+                for ev in events:
+                    work.put(ev)
+                work.join()
+
+            # --- the join storm: every member + every tenant pid ---
+            joins = [
+                {"type": "group.join", "group": f"sg{gi}",
+                 "member": f"m{mi}", "topics": ["storm"]}
+                for gi in range(groups) for mi in range(members)
+            ]
+            regs = [
+                {"type": "producer.register", "name": f"t{k}/storm"}
+                for k in range(tenants)
+            ]
+            n_events = 0
+            before = len(lat_ms)
+            run_events(joins + regs)
+            n_events += len(joins) + len(regs)
+
+            # --- churn rounds: churn_frac of members leave+rejoin ---
+            rng = random.Random(1234)
+            roster = [(gi, mi) for gi in range(groups)
+                      for mi in range(members)]
+            for _ in range(churn_rounds):
+                sample = rng.sample(roster,
+                                    max(1, int(len(roster) * churn_frac)))
+                leaves = [
+                    {"type": "group.leave", "group": f"sg{gi}",
+                     "member": f"m{mi}"}
+                    for gi, mi in sample
+                ]
+                run_events(leaves)
+                rejoins = [
+                    {"type": "group.join", "group": f"sg{gi}",
+                     "member": f"m{mi}", "topics": ["storm"]}
+                    for gi, mi in sample
+                ]
+                run_events(rejoins)
+                n_events += len(leaves) + len(rejoins)
+            assert len(lat_ms) - before + len(errs) >= n_events * 0.95, (
+                f"storm lost events: {len(lat_ms)} acks, errors {errs[:5]}"
+            )
+
+            # --- heartbeat window: every member beats continuously ---
+            stop = _threading.Event()
+            beat_counts = [0] * n_workers
+
+            def beater(w: int):
+                mine = roster[w::n_workers]
+                while not stop.is_set():
+                    for gi, mi in mine:
+                        if stop.is_set():
+                            return
+                        clients[w].call(
+                            addrs[(w + gi) % len(addrs)],
+                            {"type": "group.heartbeat",
+                             "group": f"sg{gi}", "member": f"m{mi}"},
+                            timeout=15.0,
+                        )
+                        beat_counts[w] += 1
+
+            hb_before = _cp_stats(cluster, addrs)
+            beaters = [_threading.Thread(target=beater, args=(w,),
+                                         daemon=True)
+                       for w in range(n_workers)]
+            t0 = time.perf_counter()
+            for t in beaters:
+                t.start()
+            time.sleep(beat_window_s)
+            stop.set()
+            for t in beaters:
+                t.join(timeout=10)
+            # Let the last relay frames flush before reading counters.
+            time.sleep(config.heartbeat_relay_s * 2 + 0.1)
+            window = time.perf_counter() - t0
+            hb_after = _cp_stats(cluster, addrs)
+
+            for _ in threads:
+                work.put(None)
+            for t in threads:
+                t.join(timeout=5)
+
+            stats = hb_after
+            waves = stats["waves"]
+            wave_events = stats["wave_events"]
+            beats_issued = sum(beat_counts)
+            # beat_frames counts FRAMES (one per broker per relay
+            # interval — the leader's RPC load); beats_relayed counts
+            # the per-member stamps those frames carried.
+            frames = stats["beat_frames"] - hb_before["beat_frames"]
+            proposals = waves if meta_batch_s > 0 else n_events
+            arm = {
+                "groups": groups, "members": groups * members,
+                "tenants": tenants,
+                "membership_events": n_events,
+                "raft_proposals": proposals,
+                "proposals_per_event": round(proposals / n_events, 4),
+                "proposal_collapse": round(n_events / max(1, proposals),
+                                           1),
+                "wave_size_hist": stats["wave_size_hist"],
+                "convergence_ms_p50": round(
+                    float(np.percentile(lat_ms, 50)), 2),
+                "convergence_ms_p99": round(
+                    float(np.percentile(lat_ms, 99)), 2),
+                # Before the relay plane every member beat was an RPC
+                # ON THE LEADER; now the leader ingests O(brokers)
+                # aggregated frames per relay interval.
+                "leader_heartbeat_rpcs_per_s_before": round(
+                    beats_issued / window, 1),
+                "leader_heartbeat_rpcs_per_s_after": round(
+                    frames / window, 1),
+                "errors": len(errs),
+            }
+            return arm
+
+    out: dict = {"shapes": []}
+    g0, m0, t0_ = shapes[0]
+    out["direct_baseline"] = one_arm(g0, m0, t0_, meta_batch_s=0.0)
+    for groups, members, tenants in shapes:
+        out["shapes"].append(one_arm(groups, members, tenants,
+                                     meta_batch_s=0.05))
+    out["headline"] = out["shapes"][-1]
+    return {"control_plane_storm": out}
+
+
+def _cp_stats(cluster, addrs: list[str]) -> dict:
+    """Sum the `control_plane` admin.stats block across brokers (waves
+    and events count where the proposing broker coalesced them; beat
+    frames count where the leader ingested them)."""
+    probe = cluster.client("storm-stats")
+    total = {"waves": 0, "wave_events": 0, "beats_relayed": 0,
+             "beat_frames": 0, "heartbeats_local": 0,
+             "wave_size_hist": {}}
+    for addr in addrs:
+        try:
+            st = probe.call(addr, {"type": "admin.stats"}, timeout=5.0)
+        except Exception:
+            continue
+        cp = st.get("control_plane") or {}
+        total["waves"] += int(cp.get("waves", 0))
+        total["wave_events"] += int(cp.get("wave_events", 0))
+        total["beats_relayed"] += int(cp.get("beats_relayed", 0))
+        total["beat_frames"] += int(cp.get("beat_frames", 0))
+        total["heartbeats_local"] += int(cp.get("heartbeats_local", 0))
+        for k, v in (cp.get("wave_size_hist") or {}).items():
+            total["wave_size_hist"][k] = (
+                total["wave_size_hist"].get(k, 0) + int(v)
+            )
+    return total
+
+
 def _run_consume_fanout(consumer_counts: tuple[int, ...] = (4, 16),
                         partitions: int = 2, n_msgs: int = 480) -> dict:
     """Fan-out consume A/B (ISSUE 16): C independent consumers each
@@ -2159,6 +2404,9 @@ def main() -> None:
     # ISSUE 16: fan-out consume A/B — follower reads OFF vs ON over
     # subprocess brokers, consumer-count sweep, count-exact per arm.
     consume_fanout = _run_consume_fanout()
+    # ISSUE 18: control-plane wave batching at volume — proposal
+    # collapse, leader heartbeat RPC load before/after, convergence.
+    control_plane_storm = _run_control_plane_storm()
     e2e = _run_e2e()
     # ISSUE 12: the multi-core host plane's same-host worker sweep
     # (workers 1/2/4, subprocess clients everywhere, count-exact).
@@ -2195,6 +2443,7 @@ def main() -> None:
                 "slo_convergence": slo_convergence,
                 "split_rebalance": split_rebalance,
                 "consume_fanout": consume_fanout,
+                **control_plane_storm,
                 **group_consume,
                 **e2e,
             }
@@ -2217,5 +2466,10 @@ if __name__ == "__main__":
         # Standalone elastic-split rebalance phase:
         #     python bench.py split_rebalance
         print(json.dumps({"split_rebalance": _run_split_rebalance()}))
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "control_plane_storm":
+        # Standalone control-plane volume sweep (in-proc brokers, no
+        # engine work):
+        #     python bench.py control_plane_storm
+        print(json.dumps(_run_control_plane_storm()))
     else:
         main()

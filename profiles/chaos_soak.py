@@ -58,6 +58,15 @@ def main() -> int:
                          "dual ownership, acked commits survive "
                          "rebalance, stale commits fenced, bounded "
                          "post-storm convergence)")
+    ap.add_argument("--churn-storm", action="store_true",
+                    help="join the churn_burst op to the nemesis pool "
+                         "(needs --groups): several members leave+rejoin "
+                         "simultaneously so the control plane's wave "
+                         "batching forms wide multi-member OP_BATCH "
+                         "proposals whose boundaries race the same "
+                         "phase's controller crashes/SIGKILLs; the group "
+                         "invariants must hold unconditionally over the "
+                         "batched path on either backend")
     ap.add_argument("--replication", choices=["full", "striped"],
                     default="full",
                     help="'striped' runs the cluster with Reed–Solomon "
@@ -169,6 +178,7 @@ def main() -> int:
             schedule=schedule,
             backend=args.backend,
             groups=args.groups,
+            churn_storm=args.churn_storm,
             replication_mode=args.replication,
             include_timeline=args.timeline,
             include_postmortems=args.postmortems,

@@ -63,6 +63,22 @@ DECLARED_EDGES: tuple[tuple[str, str, str], ...] = (
         "combined graph stays acyclic — which find_cycles verifies, "
         "since declared edges join the derived set before the SCC pass.",
     ),
+    (
+        "BrokerServer._intake_drain_lock", "InProcNetwork._lock",
+        "_drain_intake holds the drain lock across propose_cmd (waves "
+        "must reach the raft plane in formation order — releasing "
+        "before the propose would let a duty tick and a full-queue "
+        "inline drain reorder two waves), and propose_cmd forwards "
+        "through self._raft_client, typed as the abstract Transport "
+        "and bound at construction (net.client(...)): INTERFACE "
+        "indirection the call graph does not follow. On the in-proc "
+        "backend the concrete transport is InProcClient, whose call "
+        "path takes InProcNetwork._lock for fault-injection "
+        "bookkeeping. Witnessed by the PR 18 churn-storm chaos runs; "
+        "acyclic because InProcNetwork._lock is a strict leaf — "
+        "deliver() releases it before dispatching the handler, so the "
+        "reverse ordering cannot occur.",
+    ),
 )
 
 _LOCK_CTORS = {

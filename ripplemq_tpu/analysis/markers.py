@@ -44,6 +44,7 @@ FAST_MODULES = {
     "test_follower_reads",      # ~50 s: plane/lease units, 2-mode byte
                                 # identity, 3 chaos smokes (1 proc)
     "test_graft",
+    "test_group_waves",         # ~5 s: wave-apply units + one cluster run
     "test_groups",              # ~30 s: coordinator units + one cluster run
     "test_hostplane",           # ~15 s: worker spawns are jax-free (~100 ms)
     "test_hostplane_chaos",     # ~35 s: one seeded run + prefix parity

@@ -185,6 +185,9 @@ class PartitionManager:
         self.producer_seen: dict[str, int] = {}
         # Consumer groups: replicated membership/generation/assignment.
         self.groups = GroupTable()
+        # True while an OP_BATCH wave is expanding (lock held): group
+        # membership sub-ops defer their rebalance to the wave end.
+        self._in_wave = False
         # Optional flight recorder (the owning BrokerServer's): group
         # lifecycle events — join/leave/eviction/generation bumps — are
         # control-plane transitions a rebalance timeline needs.
@@ -233,10 +236,34 @@ class PartitionManager:
         with self.lock:
             self._applied_index = index
             if cmd.get("op") == OP_BATCH:
-                for sub in cmd["cmds"]:
-                    self._apply_one(sub)
+                # One WAVE: sub-ops expand in order, but each touched
+                # group's rebalance is deferred to the end of the wave —
+                # N membership events to one group cost ONE generation
+                # bump and ONE assignment compute, and a duplicate wave
+                # (leader retry straddling a failover re-proposing the
+                # same cmds) finds every sub-op a no-op and bumps
+                # nothing. The wave flag routes _apply_group_join/_leave
+                # onto the deferred path; everything else applies
+                # exactly as it would standalone.
+                self._in_wave = True
+                try:
+                    for sub in cmd["cmds"]:
+                        self._apply_one(sub)
+                finally:
+                    self._in_wave = False
+                    self._finish_wave()
             else:
                 self._apply_one(cmd)
+
+    def _finish_wave(self) -> None:
+        """Rebalance every group the wave changed (lock held)."""
+        parts = {t.name: t.partitions for t in self.config.topics}
+        for group, st in self.groups.finish_wave(parts):
+            if self.recorder is not None:
+                self.recorder.record(
+                    "group_rebalance", group=group,
+                    generation=st.generation, members=len(st.members),
+                )
 
     def _apply_one(self, cmd: dict) -> None:
         """One command, lock held (apply + OP_BATCH expansion)."""
@@ -652,8 +679,11 @@ class PartitionManager:
 
     def _apply_group_join(self, group: str, member: str,
                           topics: tuple[str, ...]) -> None:
-        parts = {t.name: t.partitions for t in self.config.topics}
-        st, changed = self.groups.join(group, member, topics, parts)
+        if self._in_wave:
+            st, changed = self.groups.join_deferred(group, member, topics)
+        else:
+            parts = {t.name: t.partitions for t in self.config.topics}
+            st, changed = self.groups.join(group, member, topics, parts)
         if changed and self.recorder is not None:
             self.recorder.record(
                 "group_join", group=group, member=member,
@@ -662,8 +692,11 @@ class PartitionManager:
 
     def _apply_group_leave(self, group: str, member: str,
                            reason: str) -> None:
-        parts = {t.name: t.partitions for t in self.config.topics}
-        st, changed, emptied = self.groups.leave(group, member, parts)
+        if self._in_wave:
+            st, changed, emptied = self.groups.leave_deferred(group, member)
+        else:
+            parts = {t.name: t.partitions for t in self.config.topics}
+            st, changed, emptied = self.groups.leave(group, member, parts)
         # An emptied group is RETAINED (generation + offsets intact):
         # transient total-churn must not reset the group's identity.
         # The metadata leader reaps it via OP_GROUP_DELETE only after

@@ -210,6 +210,19 @@ class _ReplStreamGate:
             self._cond.notify_all()
 
 
+class _WaveWaiter:
+    """One enqueued control-plane command's handle: the RPC handler
+    parks on `event` until the wave carrying the command is PROPOSED
+    (`ok` = the propose outcome) — commitment is still observed by the
+    handler's own local-apply poll, exactly as on the unbatched path."""
+
+    __slots__ = ("event", "ok")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.ok = False
+
+
 class BrokerServer:
     """One broker. `net` is an InProcNetwork for single-process clusters
     (tests, single-chip deployments) or None for real TCP sockets."""
@@ -391,6 +404,38 @@ class BrokerServer:
         # rule as member sessions).
         self._group_liveness = GroupLiveness()
         self._group_empty_since: dict[str, float] = {}
+        # --- control-plane wave batching (_batch_duty) ---
+        # Membership/pid commands received by THIS broker queue here and
+        # ride ONE OP_BATCH proposal per wave (meta_batch_s cadence, or
+        # early at meta_batch_max) instead of one raft proposal each.
+        # Each entry carries the waiter its RPC handler blocks on until
+        # the wave is proposed. Both locks are leaves: never held across
+        # a propose/RPC, so they stay out of every existing lock order.
+        self._intake_lock = make_lock("BrokerServer._intake_lock")
+        self._intake: list[tuple[dict, _WaveWaiter]] = []
+        # Serializes wave formation: waves must reach the metadata
+        # leader in FIFO intake order (an enqueue that hits
+        # meta_batch_max drains inline, racing the duty tick).
+        self._intake_drain_lock = make_lock(
+            "BrokerServer._intake_drain_lock"
+        )
+        self._last_wave = 0.0
+        self._wave_count = 0       # waves proposed (OP_BATCH commands)
+        self._wave_events = 0      # sub-commands carried by those waves
+        self._wave_failures = 0    # waves whose propose ultimately failed
+        self._wave_size_hist: dict[str, int] = {}  # pow2 bucket → waves
+        # --- heartbeat relay plane (_beats_relay_duty) ---
+        # Member heartbeats are ANSWERED locally from the replicated
+        # group view and the per-member stamps buffered here; one
+        # group.beats frame per heartbeat_relay_s carries them to the
+        # metadata leader's liveness ledger — leader heartbeat RPC load
+        # is O(brokers), not O(members).
+        self._beat_lock = make_lock("BrokerServer._beat_lock")
+        self._beat_buffer: set[tuple[str, str]] = set()
+        self._beats_relayed = 0    # stamps this LEADER ingested from frames
+        self._beat_frames = 0      # frames this broker delivered
+        self._heartbeats_local = 0  # member beats answered locally
+        self._last_beat_relay = 0.0
         # Producer-id expiry (metadata-leader duty): volatile ledger
         # name → (seen counter, first observed at) — the same per-
         # tenure grace rule as group liveness: cleared on losing the
@@ -901,6 +946,10 @@ class BrokerServer:
             return
         self._stopped = True
         self._stop.set()
+        # Release handlers parked on un-proposed waves before joining
+        # the duty thread (their RPC workers would otherwise hold the
+        # full waiter timeout).
+        self._fail_pending_waves()
         self.slo.stop()
         self._duty_thread.join(timeout=2)
         self.runner.stop()
@@ -1123,6 +1172,27 @@ class BrokerServer:
             stats["host_plane"] = None
         else:
             stats["host_plane"] = self.hostplane.stats()
+        # Control-plane wave batching + heartbeat relay: how many
+        # OP_BATCH waves this broker formed, the sub-commands they
+        # carried (proposals_saved = events - waves: raft proposals the
+        # coalescing avoided), the wave-size histogram (pow2 buckets),
+        # and the relay plane's counters — beats answered locally,
+        # frames delivered, stamps ingested while leading. `enabled:
+        # false` shape (counters intact) when meta_batch_s is 0.
+        with self._intake_lock:
+            intake_depth = len(self._intake)
+        stats["control_plane"] = {
+            "enabled": self.config.meta_batch_s > 0,
+            "waves": self._wave_count,
+            "wave_events": self._wave_events,
+            "wave_failures": self._wave_failures,
+            "wave_size_hist": dict(self._wave_size_hist),
+            "proposals_saved": self._wave_events - self._wave_count,
+            "intake_depth": intake_depth,
+            "heartbeats_local": self._heartbeats_local,
+            "beat_frames": self._beat_frames,
+            "beats_relayed": self._beats_relayed,
+        }
         # SLO autopilot: mode, current knob values, shed/refusal counts,
         # and the tick/transition history chaos verdicts replay
         # (`enabled: false` shape when the loop is off — the admission
@@ -1681,20 +1751,39 @@ class BrokerServer:
             return {"ok": False, "error": "not_leader", "leader": None}
         return {"ok": True, "index": index}
 
+    def _propose_retry_policy(self, retries: int) -> RetryPolicy:
+        """Retry spacing for leader-forwarded proposals. The backoff CAP
+        tracks the metadata election timeout, not just the duty
+        interval: a leaderless blip lasts about one metadata election,
+        and a cap well below it (the old duty-interval-scaled 0.5 s
+        ceiling) burned every attempt back-to-back before a new leader
+        could exist. Jitter rides the shared RetryPolicy defaults so
+        concurrent proposers decorrelate instead of thundering the
+        fresh leader together. Extracted so the spacing is directly
+        testable (tests/test_group_waves.py)."""
+        return RetryPolicy(
+            max_attempts=retries,
+            base_backoff_s=max(
+                self._duty_interval_s,
+                self.config.metadata_election_timeout_s / 8,
+            ),
+            max_backoff_s=max(
+                self._duty_interval_s, 0.5,
+                self.config.metadata_election_timeout_s,
+            ),
+            deadline_s=self.config.rpc_timeout_s * max(1, retries),
+        )
+
     def propose_cmd(self, cmd: dict, retries: int = 3) -> bool:
         """Propose a metadata command, forwarding to the metadata leader if
         this broker is not it (the reference's forwarding-with-retries,
         PartitionManager.java:219-246). Retries ride the same unified
         RetryPolicy as the clients (wire/retry.py): jittered exponential
-        backoff from the duty interval, the whole operation bounded by
-        one rpc-timeout deadline budget — a partitioned metadata leader
+        backoff spaced to the metadata election timescale
+        (_propose_retry_policy), the whole operation bounded by one
+        rpc-timeout deadline budget — a partitioned metadata leader
         costs a bounded stall, not retries x timeout."""
-        policy = RetryPolicy(
-            max_attempts=retries,
-            base_backoff_s=self._duty_interval_s,
-            max_backoff_s=max(self._duty_interval_s, 0.5),
-            deadline_s=self.config.rpc_timeout_s * max(1, retries),
-        )
+        policy = self._propose_retry_policy(retries)
         run = policy.begin()
         while run.attempt():
             node = self.runner.node
@@ -1719,6 +1808,177 @@ class BrokerServer:
                 else:
                     run.note("no metadata leader hint")
         return False
+
+    # -- control-plane wave batching ---------------------------------------
+    # Membership/pid commands coalesce into OP_BATCH waves: each broker
+    # queues the commands its own RPC handlers receive and proposes ONE
+    # wave per meta_batch_s (early at meta_batch_max), so the metadata
+    # leader's raft proposal load under a churn storm is O(brokers) per
+    # wave interval instead of O(membership events). The wave apply
+    # (PartitionManager.apply) defers each touched group's rebalance to
+    # the end of the wave — one generation bump per group per wave —
+    # and its sub-op idempotence makes a duplicate wave (leader retry
+    # straddling a failover) a no-op.
+
+    def _submit_meta(self, cmd: dict) -> bool:
+        """Route one metadata command onto the wave intake (meta_batch_s
+        > 0) or propose it directly (batching disabled — the pre-wave
+        shape, also the bench's 'before' arm). Returns whether the
+        command was proposed; the caller still polls its own local
+        apply for commitment, unchanged."""
+        if self.config.meta_batch_s <= 0:
+            return self.propose_cmd(cmd)
+        waiter = _WaveWaiter()
+        cap = 4 * self.config.meta_batch_max
+        with self._intake_lock:
+            if len(self._intake) >= cap:
+                # Bounded intake: refuse retryably instead of queueing
+                # unboundedly — the client's backoff is the ladder.
+                return False
+            self._intake.append((cmd, waiter))
+            full = len(self._intake) >= self.config.meta_batch_max
+        if full:
+            # A full wave needn't wait for the duty tick: the enqueuing
+            # handler thread forms it inline (it would only block on the
+            # waiter otherwise).
+            self._drain_intake()
+        waiter.event.wait(
+            self.config.meta_batch_s + self.config.rpc_timeout_s * 3
+        )
+        return waiter.ok
+
+    def _drain_intake(self) -> None:
+        """Form and propose waves until the intake is empty (FIFO; at
+        most meta_batch_max commands per wave). Serialized by the drain
+        lock — concurrent triggers (duty tick vs a full-queue enqueue)
+        must not reorder waves."""
+        with self._intake_drain_lock:
+            while True:
+                with self._intake_lock:
+                    batch = self._intake[: self.config.meta_batch_max]
+                    del self._intake[: len(batch)]
+                if not batch:
+                    return
+                self._last_wave = time.monotonic()
+                cmds = [c for c, _ in batch]
+                ok = self.propose_cmd({"op": OP_BATCH, "cmds": cmds})
+                self._wave_count += 1
+                self._wave_events += len(cmds)
+                if not ok:
+                    self._wave_failures += 1
+                bucket = str(1 << (len(cmds) - 1).bit_length())
+                self._wave_size_hist[bucket] = (
+                    self._wave_size_hist.get(bucket, 0) + 1
+                )
+                self.recorder.record(
+                    "meta_batch", size=len(cmds), ok=ok,
+                )
+                for _, w in batch:
+                    w.ok = ok
+                    w.event.set()
+
+    def _batch_duty(self) -> None:
+        """Wave cadence: propose the queued commands once meta_batch_s
+        has passed since the last wave (size-triggered waves drain
+        inline from the enqueuing thread, see _submit_meta)."""
+        if self.config.meta_batch_s <= 0:
+            return
+        with self._intake_lock:
+            pending = len(self._intake)
+        if not pending:
+            return
+        if (time.monotonic() - self._last_wave
+                < self.config.meta_batch_s):
+            return
+        self._drain_intake()
+
+    def _fail_pending_waves(self) -> None:
+        """stop(): release every parked handler (propose refused)."""
+        with self._intake_lock:
+            pending = list(self._intake)
+            del self._intake[:]
+        for _, w in pending:
+            w.ok = False
+            w.event.set()
+
+    # -- heartbeat relay ---------------------------------------------------
+
+    def _beats_relay_duty(self) -> None:
+        """Forward the locally-buffered member beats to the metadata
+        leader's liveness ledger as ONE group.beats frame per
+        heartbeat_relay_s. A frame that cannot be delivered (no leader,
+        leader moved, wire error) re-merges into the buffer and retries
+        next tick — the stamps are idempotent monotonic refreshes, and
+        the leader-change grace window (GroupLiveness first-sighting
+        seeding) absorbs delivery gaps exactly as it absorbs leader
+        churn."""
+        now = time.monotonic()
+        if now - self._last_beat_relay < self.config.heartbeat_relay_s:
+            return
+        with self._beat_lock:
+            if not self._beat_buffer:
+                return
+            beats = sorted(self._beat_buffer)
+            self._beat_buffer.clear()
+        self._last_beat_relay = now
+        delivered = False
+        node = self.runner.node
+        if node.role == LEADER:
+            # This broker IS the ledger's owner: stamp directly.
+            self._ingest_beats(beats)
+            delivered = True
+        else:
+            hint = node.leader_hint
+            if hint is not None and hint != self.broker_id:
+                try:
+                    resp = self._raft_client.call(
+                        self._addr_of(hint),
+                        {"type": "group.beats",
+                         "beats": [[g, m] for g, m in beats]},
+                        timeout=min(2.0, self.config.rpc_timeout_s),
+                    )
+                    delivered = bool(resp.get("ok"))
+                except RpcError:
+                    delivered = False
+        if delivered:
+            self._beat_frames += 1
+        else:
+            with self._beat_lock:
+                self._beat_buffer.update(beats)
+        self.recorder.record(
+            "beats_relay", beats=len(beats), ok=delivered,
+        )
+
+    def _ingest_beats(self, beats) -> None:
+        """Metadata leader: stamp each relayed (group, member) beat
+        whose membership the replicated table confirms — per-member
+        stamps preserved, evicted/unknown members dropped (their
+        originating broker answers them unknown_member on the next
+        heartbeat once the leave applies there)."""
+        stamped = 0
+        for group, member in beats:
+            st = self.manager.group_state(str(group))
+            if st is not None and str(member) in st.members:
+                self._group_liveness.beat(str(group), str(member))
+                stamped += 1
+        if stamped:
+            # Reached from RPC handler threads (group.beats frames) AND
+            # the duty thread (the leader ingesting its own buffer):
+            # the counter shares the beat-buffer leaf lock.
+            with self._beat_lock:
+                self._beats_relayed += stamped
+
+    def _handle_group_beats(self, req: dict) -> dict:
+        """One broker's aggregated heartbeat frame (the relay plane's
+        leader-side ingestion point)."""
+        node = self.runner.node
+        if node.role != LEADER:
+            hint = node.leader_hint
+            return {"ok": False, "error": "not_leader", "leader": hint}
+        self._ingest_beats(
+            [(str(g), str(m)) for g, m in req.get("beats", [])]
+        )
+        return {"ok": True}
 
     # -- data path ---------------------------------------------------------
 
@@ -2375,7 +2635,7 @@ class BrokerServer:
         pid = self.manager.producer_id(name)
         if pid is not None:
             return {"ok": True, "pid": pid}
-        if not self.propose_cmd(
+        if not self._submit_meta(
             {"op": OP_REGISTER_PRODUCER, "producer": name}
         ):
             return {"ok": False, "error": "not_committed: producer "
@@ -2390,6 +2650,9 @@ class BrokerServer:
                                       "registration timed out"}
 
     def _handle_group(self, t: str, req: dict) -> dict:
+        if t == "group.beats":
+            # The relay plane's aggregated frame (no single `group`).
+            return self._handle_group_beats(req)
         group = str(req["group"])
         if t == "group.describe":
             st = self.manager.group_state(group)
@@ -2415,7 +2678,7 @@ class BrokerServer:
             st = self.manager.group_state(group)
             if (st is None or st.members.get(member)
                     != tuple(sorted(set(topics)))):
-                if not self.propose_cmd({
+                if not self._submit_meta({
                     "op": OP_GROUP_JOIN, "group": group, "member": member,
                     "topics": topics,
                 }):
@@ -2432,7 +2695,7 @@ class BrokerServer:
             st = self.manager.group_state(group)
             if st is None or member not in st.members:
                 return {"ok": True}  # idempotent
-            if not self.propose_cmd({
+            if not self._submit_meta({
                 "op": OP_GROUP_LEAVE, "group": group, "member": member,
                 "reason": str(req.get("reason", "leave")),
             }):
@@ -2446,28 +2709,26 @@ class BrokerServer:
                 time.sleep(0.01)
             return {"ok": False, "error": "not_committed: leave timed out"}
         if t == "group.heartbeat":
-            # Liveness is the METADATA LEADER's ledger (evictions are its
-            # duty): forward a follower-received beat, one hop.
-            node = self.runner.node
-            if node.role != LEADER:
-                hint = node.leader_hint
-                if hint is None or hint == self.broker_id:
-                    return {"ok": False, "error": "not_leader",
-                            "leader": hint}
-                try:
-                    return self._raft_client.call(
-                        self._addr_of(hint), dict(req),
-                        timeout=min(2.0, self.config.rpc_timeout_s),
-                    )
-                except RpcError as e:
-                    return {"ok": False, "error": f"not_leader: {e}"}
+            # Answered LOCALLY: membership/generation/assignment are
+            # replicated state, identical on every broker, so the
+            # member's view needs no leader round trip. The liveness
+            # stamp — which IS the metadata leader's ledger — is
+            # buffered and rides this broker's next group.beats frame
+            # (_beats_relay_duty): leader heartbeat RPC load collapses
+            # from O(members) to O(brokers). A member this broker's
+            # replicated view does not (yet) hold gets the same
+            # unknown_member refusal the leader gave — a lagging view
+            # heals by the member's transparent rejoin, an eviction by
+            # the same path as before.
             st = self.manager.group_state(group)
             if st is None or member not in st.members:
                 return {"ok": False,
                         "error": f"unknown_member: {member!r} not in "
                                  f"{group!r} (evicted or never joined); "
                                  f"rejoin required"}
-            self._group_liveness.beat(group, member)
+            with self._beat_lock:
+                self._beat_buffer.add((group, member))
+            self._heartbeats_local += 1
             return self._member_view(st, member)
         return {"ok": False, "error": f"unknown request type {t!r}"}
 
@@ -3208,6 +3469,8 @@ class BrokerServer:
     def _duty_loop(self) -> None:
         while not self._stop.wait(self._duty_interval_s):
             try:
+                self._batch_duty()
+                self._beats_relay_duty()
                 self._metadata_leader_duty()
                 self._producer_pid_duty()
                 self._worker_pid_duty()
@@ -3219,6 +3482,7 @@ class BrokerServer:
                 self._controller_duty()
                 self._slot_clean_duty()
                 self._standby_duty()
+                self._quota_share_duty()
                 self._follower_lease_duty()
                 self._reconfig_duty()
                 self._autosplit_duty()
@@ -3229,6 +3493,30 @@ class BrokerServer:
                 with self._errors_lock:
                     self.duty_errors.append(f"{type(e).__name__}: {e}")
                     del self.duty_errors[:-20]
+
+    def _quota_share_duty(self) -> None:
+        """Cluster-level quotas: rescale this broker's per-tenant
+        admission buckets by its CURRENT share of partition leaderships
+        (slo/admission.py set_leadership_share) — a tenant's quota is a
+        cluster rate, not rate × brokers. Floored at one partition's
+        worth even with zero leaderships: admission runs before the
+        leadership check in the produce handler, and a zero-rate bucket
+        would answer stale-routed produces `overloaded:` instead of the
+        `not_leader` redirect that re-resolves the client's routing."""
+        if not self.config.slo_quotas:
+            return
+        total = 0
+        led = 0
+        for t in self.manager.get_topics():
+            for a in t.assignments:
+                if a.state == "retired":
+                    continue
+                total += 1
+                if a.leader == self.broker_id:
+                    led += 1
+        if total <= 0:
+            return
+        self.slo.admission.set_leadership_share(max(led, 1) / total)
 
     def _follower_lease_duty(self) -> None:
         """Metadata-leader duty: keep the follower-read lease table
@@ -3424,14 +3712,25 @@ class BrokerServer:
             evict = self._group_liveness.plan_evictions(
                 table, self.config.group_session_timeout_s
             )
+        evict_cmds = []
         for group, member in evict:
             log.info("broker %d: evicting group member %s/%s "
                      "(session lapsed)", self.broker_id, group, member)
             self._group_liveness.forget(group, member)
-            self.propose_cmd(
+            evict_cmds.append(
                 {"op": OP_GROUP_LEAVE, "group": group, "member": member,
-                 "reason": "evicted"},
-                retries=1,
+                 "reason": "evicted"}
+            )
+        if len(evict_cmds) == 1:
+            self.propose_cmd(evict_cmds[0], retries=1)
+        elif evict_cmds:
+            # A session-timeout storm evicts as ONE wave: the batch
+            # apply defers each group's rebalance to the wave end, so a
+            # mass eviction costs one generation bump per group, not
+            # one per member (the same collapse the join path gets from
+            # _submit_meta).
+            self.propose_cmd(
+                {"op": OP_BATCH, "cmds": evict_cmds}, retries=1
             )
         # Empty-group retention: a group with zero members keeps its
         # generation and shared offsets (transient total-churn must not

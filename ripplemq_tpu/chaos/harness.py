@@ -587,6 +587,7 @@ def run_chaos(
     include_postmortems: bool = False,
     include_timeline: bool = False,
     groups: int = 0,
+    churn_storm: bool = False,
     replication_mode: str = "full",
     lock_witness: bool = False,
     host_workers: int = 1,
@@ -623,6 +624,14 @@ def run_chaos(
     checker then also asserts the group invariants
     (check_group_history) and the verdict carries a `group` section
     with post-heal convergence to one stable generation.
+
+    `churn_storm=True` (needs `groups > 0`) joins the churn-burst op:
+    several members leave+rejoin simultaneously, so the brokers' wave
+    coalescing (meta_batch_s) forms WIDE multi-member OP_BATCH
+    proposals whose boundaries race the same phase's controller
+    crashes/SIGKILLs — the batched control plane must uphold every
+    group invariant unconditionally (duplicate-wave replays across a
+    failover included). Either backend.
 
     A VIOLATING verdict always carries `postmortems` (one
     admin.postmortem bundle per reachable broker — the diagnosis the
@@ -740,8 +749,11 @@ def run_chaos(
             topics=(Topic(topic, partitions, replication),),
             linearizable_reads=True,  # same checker rationale as below
             # Short member sessions so a paused member's eviction (and
-            # the rebalance it forces) lands INSIDE a chaos phase.
+            # the rebalance it forces) lands INSIDE a chaos phase; the
+            # beat-relay cadence scales down with it (default 0.5 s
+            # leaves no margin against a 0.25 s workload heartbeat).
             group_session_timeout_s=0.8,
+            heartbeat_relay_s=0.2,
             replication=replication_mode,
             # host_workers > 1 drives the multi-core host plane on real
             # broker subprocesses: every produce stamps/packs through a
@@ -766,6 +778,7 @@ def run_chaos(
             # opts IN, so every surviving violation is a real bug.
             linearizable_reads=True,
             group_session_timeout_s=0.8,  # see the proc branch above
+            heartbeat_relay_s=0.2,  # see the proc branch above
             replication=replication_mode,
             host_workers=host_workers,  # see the proc branch above
             spare_slots=splits,
@@ -777,7 +790,7 @@ def run_chaos(
                      "replication": replication_mode,
                      "host_workers": host_workers,
                      "follower_reads": follower_reads,
-                     "splits": splits}
+                     "splits": splits, "churn_storm": churn_storm}
     try:
         cluster.start()
         cluster.wait_for_leaders()
@@ -785,7 +798,8 @@ def run_chaos(
                           ops_per_phase=ops_per_phase, schedule=schedule,
                           backend=backend, group_members=groups,
                           striped=(replication_mode == "striped"),
-                          elastic=(splits > 0))
+                          elastic=(splits > 0),
+                          churn_storm=churn_storm)
         # Wait for one replication standby before the first crash:
         # settled appends are then provably on a promotable peer.
         deadline = time.time() + (120 if backend == "proc" else 20)

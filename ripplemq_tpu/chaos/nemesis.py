@@ -24,6 +24,8 @@ Fault vocabulary (composing the InProcNetwork hooks, wire/transport.py):
                  (elastic runs; resolved at apply time, admin.split)
   merge_partitions i  reabsorb the i-th mergeable split child
                  (elastic runs; resolved at apply time, admin.merge)
+  churn_burst [i...]  simultaneous leave+rejoin of several group
+                 members (churn_storm runs; stresses wave batching)
 
 Crash scheduling keeps a metadata majority alive (at most (n-1)//2
 concurrently crashed) — the checker tests safety under faults the
@@ -78,6 +80,18 @@ _GROUP_OP_WEIGHTS = (
 
 _GROUP_OPS = tuple(n for n, _ in _GROUP_OP_WEIGHTS)
 
+# Churn-storm op (the `churn_storm` knob, runs with group members): a
+# BURST of simultaneous membership churns — several members leave and
+# rejoin at once, so the brokers' wave coalescing (meta_batch_s) forms
+# a multi-member OP_BATCH whose boundary races whatever else the pool
+# is doing to the controller: crash/SIGKILL, partitions, disk damage.
+# The duplicate-wave idempotence claim (a leader retry straddling a
+# failover replays the whole wave) only gets exercised when waves are
+# WIDE, which single member_churn ops rarely produce. The op carries
+# the member INDEX LIST chosen at schedule time — purity preserved;
+# backend-agnostic like the other group ops.
+_CHURN_BURST_WEIGHT = 4
+
 # Stripe-holder ops (runs with replication="striped"): attack the
 # striped plane's k-of-k+m durability contract as a first-class
 # surface. Ops name a stripe INDEX (0..k+m-1) — the schedule stays a
@@ -126,6 +140,7 @@ def make_schedule(
     group_members: int = 0,
     striped: bool = False,
     elastic: bool = False,
+    churn_storm: bool = False,
 ) -> list[list[dict]]:
     """Deterministic [phases][ops] fault schedule. Each phase ends with
     an implicit heal (the nemesis records it in the trace), so phases
@@ -134,9 +149,10 @@ def make_schedule(
     faults); `group_members > 0` joins the rebalance-storm ops,
     `striped` the stripe-holder ops (sized to RS_M kills per phase),
     `elastic` the online split/merge ops (both backends — they ride
-    the admin RPC surface) — the schedule stays a pure function of
-    (seed, roster, shape, backend, group_members, striped, elastic),
-    so any run replays byte-for-byte."""
+    the admin RPC surface), `churn_storm` the multi-member churn-burst
+    op (needs group members) — the schedule stays a pure function of
+    (seed, roster, shape, backend, group_members, striped, elastic,
+    churn_storm), so any run replays byte-for-byte."""
     from ripplemq_tpu.stripes.codec import RS_K, RS_M
 
     rng = random.Random(seed)
@@ -145,6 +161,8 @@ def make_schedule(
         pool.append(("kill_worker", 1))
     if group_members > 0:
         pool.extend(_GROUP_OP_WEIGHTS)
+        if churn_storm:
+            pool.append(("churn_burst", _CHURN_BURST_WEIGHT))
     if striped:
         pool.extend(
             _STRIPE_OP_WEIGHTS if backend == "inproc"
@@ -224,6 +242,14 @@ def make_schedule(
             elif name == "kill_worker":
                 ops.append({"op": "kill_worker",
                             "worker": rng.choice(list(lockstep_workers))})
+            elif name == "churn_burst":
+                # Half the roster (at least 2) churns inside one wave
+                # window — wide enough that the coalesced OP_BATCH
+                # carries a real multi-member wave.
+                k = min(group_members, max(2, group_members // 2))
+                ops.append({"op": "churn_burst",
+                            "members": sorted(
+                                rng.sample(range(group_members), k))})
             elif name in _GROUP_OPS:
                 ops.append({"op": name,
                             "member": rng.randrange(group_members)})
@@ -278,7 +304,8 @@ class Nemesis:
                  backend: str = "inproc",
                  group_members: int = 0,
                  striped: bool = False,
-                 elastic: bool = False) -> None:
+                 elastic: bool = False,
+                 churn_storm: bool = False) -> None:
         self.cluster = cluster
         self.seed = seed
         self.backend = backend
@@ -296,6 +323,7 @@ class Nemesis:
             group_members=group_members,
             striped=striped,
             elastic=elastic,
+            churn_storm=churn_storm,
         )
         self.trace: list[dict] = []
         # Elastic-op resolution forensics: what each scheduled
@@ -369,6 +397,13 @@ class Nemesis:
             if b in self._crashed:
                 self._crashed.discard(b)
                 self.cluster.restart(b)
+            return
+        if kind == "churn_burst":
+            # Storm burst: churn every listed member back-to-back so
+            # their leaves+rejoins coalesce into one (or few) waves.
+            if self.group_ops is not None:
+                for i in op["members"]:
+                    self.group_ops.churn(i)
             return
         if kind in _GROUP_OPS:
             # Rebalance-storm ops act on the group workload's members

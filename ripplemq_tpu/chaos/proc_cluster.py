@@ -123,6 +123,12 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "membership_poll_s": config.membership_poll_s,
         "group_session_timeout_s": config.group_session_timeout_s,
         "group_retention_s": config.group_retention_s,
+        # Control-plane wave batching: the wave cadence/size and the
+        # heartbeat relay interval must round-trip or the subprocess
+        # backend runs a different control-plane shape than in-proc.
+        "meta_batch_s": config.meta_batch_s,
+        "meta_batch_max": config.meta_batch_max,
+        "heartbeat_relay_s": config.heartbeat_relay_s,
         "metadata_refresh_s": config.metadata_refresh_s,
         "rpc_timeout_s": config.rpc_timeout_s,
         "controller_id": config.controller_id,

@@ -16,15 +16,35 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-from ripplemq_tpu.groups.state import GroupState, compute_assignment
+from ripplemq_tpu.groups.state import (
+    GroupState,
+    compute_assignment,
+    compute_assignment_delta,
+)
 
 
 class GroupTable:
     """All groups' replicated state. NOT internally locked: the owner
-    (PartitionManager) serializes applies and reads under its own lock."""
+    (PartitionManager) serializes applies and reads under its own lock.
+
+    Two mutation modes: the per-op path (`join`/`leave`) rebalances the
+    touched group IMMEDIATELY — one generation bump per membership
+    event, the pre-wave shape that standalone OP_GROUP_JOIN /
+    OP_GROUP_LEAVE applies still use. The WAVE path
+    (`join_deferred`/`leave_deferred` + `finish_wave`) applies every
+    membership mutation of one OP_BATCH wave first and rebalances each
+    TOUCHED group exactly once at the end: N joins to one group cost
+    one generation bump and one assignment compute, and a replayed
+    duplicate wave (leader retry straddling a failover) finds every
+    sub-op a no-op and bumps nothing."""
 
     def __init__(self) -> None:
         self.groups: dict[str, GroupState] = {}
+        # Transient wave bookkeeping, alive only inside one OP_BATCH
+        # apply (the manager holds its lock across the whole wave):
+        # group → (pre-wave members snapshot, changed member ids).
+        self._wave: dict[str, tuple[dict[str, tuple[str, ...]],
+                                    set[str]]] = {}
 
     def join(self, group: str, member: str, topics: tuple[str, ...],
              topic_partitions: dict[str, int]) -> tuple[GroupState, bool]:
@@ -32,6 +52,14 @@ class GroupTable:
         with an unchanged subscription is a no-op (join proposals are
         retried/duplicated by clients; idempotence keeps the generation
         from churning under replays)."""
+        st, changed = self._join_members(group, member, topics)
+        if changed:
+            self._rebalance(st, topic_partitions)
+        return st, changed
+
+    def _join_members(self, group: str, member: str,
+                      topics: tuple[str, ...]) -> tuple[GroupState, bool]:
+        """Membership half of a join (no rebalance)."""
         st = self.groups.get(group)
         if st is None:
             st = self.groups[group] = GroupState(name=group)
@@ -39,8 +67,62 @@ class GroupTable:
         if st.members.get(member) == topics:
             return st, False
         st.members[member] = topics
-        self._rebalance(st, topic_partitions)
         return st, True
+
+    # ------------------------------------------------------ wave deferral
+
+    def join_deferred(self, group: str, member: str,
+                      topics: tuple[str, ...]) -> tuple[GroupState, bool]:
+        """Wave-mode join: mutate membership now, rebalance at
+        `finish_wave`. Returns (state, changed) with the same
+        idempotence as `join`."""
+        self._wave_touch(group)
+        st, changed = self._join_members(group, member, topics)
+        if changed:
+            self._wave[group][1].add(member)
+        return st, changed
+
+    def leave_deferred(self, group: str, member: str
+                       ) -> tuple[Optional[GroupState], bool, bool]:
+        """Wave-mode leave: mutate membership now, rebalance at
+        `finish_wave`. Returns (state, changed, emptied) like `leave`."""
+        st = self.groups.get(group)
+        if st is None or member not in st.members:
+            return st, False, False
+        self._wave_touch(group)
+        del st.members[member]
+        self._wave[group][1].add(member)
+        return st, True, not st.members
+
+    def _wave_touch(self, group: str) -> None:
+        if group not in self._wave:
+            st = self.groups.get(group)
+            snapshot = dict(st.members) if st is not None else {}
+            self._wave[group] = (snapshot, set())
+
+    def finish_wave(self, topic_partitions: dict[str, int]
+                    ) -> list[tuple[str, GroupState]]:
+        """Rebalance every group the wave CHANGED — one generation bump
+        and one (incremental) assignment compute per touched group, in
+        sorted group order (deterministic across brokers). Groups whose
+        sub-ops all no-opped (a duplicate wave) are skipped: their
+        generation does not move, so a replayed wave fences nothing.
+        Returns the rebalanced (name, state) pairs for event
+        recording."""
+        out: list[tuple[str, GroupState]] = []
+        for group in sorted(self._wave):
+            prev_members, changed = self._wave[group]
+            st = self.groups.get(group)
+            if st is None or not changed:
+                continue
+            st.generation += 1
+            st.assignment = dict(compute_assignment_delta(
+                st.members, topic_partitions, st.assignment,
+                prev_members, changed,
+            ))
+            out.append((group, st))
+        self._wave.clear()
+        return out
 
     def leave(self, group: str, member: str,
               topic_partitions: dict[str, int]

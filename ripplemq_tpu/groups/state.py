@@ -74,6 +74,43 @@ class GroupState:
         )
 
 
+def _topic_quota(subs: list[str], nparts: int) -> dict[str, int]:
+    """Even-split quota per member for one topic: the first `extra`
+    members (sorted order) take one more."""
+    base, extra = divmod(nparts, len(subs))
+    return {m: base + (1 if i < extra else 0) for i, m in enumerate(subs)}
+
+
+def _assign_topic(subs: list[str], nparts: int,
+                  prev_owner: dict[GroupKey, str],
+                  topic: str) -> dict[GroupKey, str]:
+    """One topic's sticky rule: previous owners keep their partitions
+    while still subscribed and under quota; orphans fill to members
+    under quota in sorted order. Deterministic in its arguments."""
+    quota = _topic_quota(subs, nparts)
+    taken: dict[str, int] = {m: 0 for m in subs}
+    assigned: dict[GroupKey, str] = {}
+    # Sticky pass: keep previous owners under quota.
+    for pid in range(nparts):
+        key = (topic, pid)
+        owner = prev_owner.get(key)
+        if owner in quota and taken[owner] < quota[owner]:
+            assigned[key] = owner
+            taken[owner] += 1
+    # Fill pass: orphaned partitions go to members under quota, in
+    # sorted order (deterministic).
+    for pid in range(nparts):
+        key = (topic, pid)
+        if key in assigned:
+            continue
+        for m in subs:
+            if taken[m] < quota[m]:
+                assigned[key] = m
+                taken[m] += 1
+                break
+    return assigned
+
+
 def compute_assignment(
     members: dict[str, tuple[str, ...]],
     topic_partitions: dict[str, int],
@@ -93,38 +130,80 @@ def compute_assignment(
         subs = sorted(m for m, ts in members.items() if topic in ts)
         if not subs:
             continue
-        nparts = topic_partitions[topic]
-        base, extra = divmod(nparts, len(subs))
-        # Even-split quota per member for THIS topic: the first `extra`
-        # members (sorted order) take one more.
-        quota = {m: base + (1 if i < extra else 0)
-                 for i, m in enumerate(subs)}
-        taken: dict[str, int] = {m: 0 for m in subs}
-        assigned: dict[GroupKey, str] = {}
-        # Sticky pass: keep previous owners under quota.
         prev_owner = {
             key: m
             for m, keys in previous.items()
             for key in keys
             if key[0] == topic
         }
-        for pid in range(nparts):
-            key = (topic, pid)
-            owner = prev_owner.get(key)
-            if owner in quota and taken[owner] < quota[owner]:
-                assigned[key] = owner
-                taken[owner] += 1
-        # Fill pass: orphaned partitions go to members under quota, in
-        # sorted order (deterministic).
-        for pid in range(nparts):
-            key = (topic, pid)
-            if key in assigned:
-                continue
-            for m in subs:
-                if taken[m] < quota[m]:
-                    assigned[key] = m
-                    taken[m] += 1
+        assigned = _assign_topic(subs, topic_partitions[topic],
+                                 prev_owner, topic)
+        for key, m in assigned.items():
+            out[m].append(key)
+    return {m: tuple(sorted(keys)) for m, keys in out.items()}
+
+
+def compute_assignment_delta(
+    members: dict[str, tuple[str, ...]],
+    topic_partitions: dict[str, int],
+    previous: Optional[dict[str, tuple[GroupKey, ...]]],
+    prev_members: dict[str, tuple[str, ...]],
+    changed: set[str],
+) -> dict[str, tuple[GroupKey, ...]]:
+    """Incremental sticky assignment for a wave that touched only the
+    members in `changed` (joined, left, or re-subscribed between
+    `prev_members` and `members`). Topics no changed member subscribes
+    to — now or before — keep their previous per-topic slice VERBATIM:
+    the per-topic rule is a fixpoint on an unchanged subscriber set
+    (every owner sits exactly at quota, so the sticky pass keeps
+    everything and the fill pass is empty), so recomputing would return
+    the same bytes. Affected topics rerun the full per-topic rule,
+    which moves only the minimum member set by stickiness. Falls back
+    to the full rule per topic whenever the fast path's preconditions
+    fail (partition count changed under a split/merge, or the previous
+    slice is not a quota-exact cover). Output is IDENTICAL to
+    `compute_assignment(members, topic_partitions, previous)` — the
+    directed equivalence test in tests/test_group_waves.py holds this
+    over randomized churn."""
+    previous = previous or {}
+    affected: set[str] = set()
+    for m in changed:
+        affected.update(prev_members.get(m, ()))
+        affected.update(members.get(m, ()))
+    out: dict[str, list[GroupKey]] = {m: [] for m in members}
+    for topic in sorted(topic_partitions):
+        subs = sorted(m for m, ts in members.items() if topic in ts)
+        nparts = topic_partitions[topic]
+        prev_slice = [
+            (m, key)
+            for m, keys in previous.items()
+            for key in keys
+            if key[0] == topic
+        ]
+        if topic not in affected and subs:
+            # Fast path: reuse the previous slice if it is a
+            # quota-exact cover of [0, nparts) owned by current subs —
+            # exactly the states the full rule emits, on which it is
+            # idempotent.
+            quota = _topic_quota(subs, nparts)
+            counts: dict[str, int] = {m: 0 for m in subs}
+            pids = []
+            valid = True
+            for m, key in prev_slice:
+                if m not in counts:
+                    valid = False
                     break
+                counts[m] += 1
+                pids.append(key[1])
+            if valid and sorted(pids) == list(range(nparts)) \
+                    and counts == quota:
+                for m, key in prev_slice:
+                    out[m].append(key)
+                continue
+        if not subs:
+            continue
+        prev_owner = {key: m for m, key in prev_slice}
+        assigned = _assign_topic(subs, nparts, prev_owner, topic)
         for key, m in assigned.items():
             out[m].append(key)
     return {m: tuple(sorted(keys)) for m, keys in out.items()}

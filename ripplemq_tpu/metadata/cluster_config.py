@@ -55,6 +55,28 @@ class ClusterConfig:
     # caught exactly that). Members rejoining within the window resume
     # seamlessly.
     group_retention_s: float = 60.0
+    # --- Control-plane wave batching (BrokerServer._batch_duty) ---------
+    # The metadata leader drains its intake queue of membership/pid
+    # commands (group.join / group.leave / producer.register) into ONE
+    # OP_BATCH proposal per wave: at most every meta_batch_s, or as soon
+    # as meta_batch_max commands are queued. The apply expands the wave
+    # in order but defers each touched group's rebalance to the END of
+    # the wave, so N joins to one group cost one generation bump and one
+    # assignment recompute instead of N. 0 disables coalescing — every
+    # command proposes individually (the pre-wave shape).
+    meta_batch_s: float = 0.05
+    # Wave size cap: a wave is proposed early once this many commands
+    # are queued (bounds both proposal payload and the latency a full
+    # queue would add to the oldest waiter).
+    meta_batch_max: int = 256
+    # Heartbeat relay cadence: each broker aggregates the group
+    # heartbeats of its locally-connected members and forwards ONE
+    # group.beats frame per interval to the metadata leader's liveness
+    # ledger — leader heartbeat RPC load is O(brokers), not O(members).
+    # Per-member stamps are preserved; leader-change grace semantics
+    # are unchanged. Must sit well inside group_session_timeout_s or
+    # relayed beats arrive too late to keep sessions alive.
+    heartbeat_relay_s: float = 0.5
     metadata_refresh_s: float = 10.0
     rpc_timeout_s: float = 3.0
     # The broker that BOOTSTRAPS as the TPU mesh driver (device-program
@@ -401,6 +423,20 @@ class ClusterConfig:
                     f"'high' or 'low', got {tier!r}"
                 )
             tiers_seen.add(tenant)
+        if self.meta_batch_s < 0:
+            raise ValueError("meta_batch_s must be >= 0 (0 disables waves)")
+        if self.meta_batch_max < 1:
+            raise ValueError("meta_batch_max must be >= 1")
+        if self.heartbeat_relay_s <= 0:
+            raise ValueError("heartbeat_relay_s must be > 0")
+        if self.heartbeat_relay_s >= self.group_session_timeout_s:
+            raise ValueError(
+                f"heartbeat_relay_s={self.heartbeat_relay_s} must be well "
+                f"inside group_session_timeout_s="
+                f"{self.group_session_timeout_s}: a relay interval at or "
+                f"past the session timeout delivers every beat too late "
+                f"and the leader evicts healthy members"
+            )
         if self.split_evidence_ticks < 1:
             raise ValueError("split_evidence_ticks must be >= 1")
         if self.split_merge_idle_ticks < 1:
@@ -525,8 +561,12 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         "rpc_timeout_s",
         "group_session_timeout_s",
         "group_retention_s",
+        "meta_batch_s",
+        "heartbeat_relay_s",
     )
     extra = {k: float(raw[k]) for k in timing_keys if k in raw}
+    if "meta_batch_max" in raw:
+        extra["meta_batch_max"] = int(raw["meta_batch_max"])
     if raw.get("controller_id") is not None:
         extra["controller_id"] = int(raw["controller_id"])
     if "standby_count" in raw:

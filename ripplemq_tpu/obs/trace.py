@@ -55,6 +55,12 @@ EVENT_TYPES = frozenset({
     "host_worker_down", "host_worker_restart",
     # Consumer-group coordinator (manager applies + fencing).
     "group_join", "group_leave", "group_delete", "fence",
+    # Control-plane wave batching (broker/server.py _batch_duty +
+    # manager OP_BATCH apply): one wave of coalesced membership/pid
+    # commands proposed; one wave-end deferred rebalance of a touched
+    # group; one aggregated heartbeat frame relayed to the metadata
+    # leader's liveness ledger.
+    "meta_batch", "group_rebalance", "beats_relay",
     # SLO autopilot (slo/controller.py): one event per APPLIED knob
     # adjustment (the control timeline postmortems replay) and the
     # load-shedding state machine's transitions.

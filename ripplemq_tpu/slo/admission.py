@@ -35,10 +35,16 @@ ladder exists so a broker under sustained overload keeps degrading
 in priority order instead of choosing between "refuse nobody with a
 quota" and "refuse everybody".
 
-Quotas are enforced PER BROKER: a tenant's effective cluster rate is
-its quota times the partition-leader brokers it produces to, the same
-per-serving-node semantics as every broker-local limiter (documented
-in the README SLO section). The clock is injectable so tier-1 tests
+Quotas are CLUSTER-LEVEL: each broker scales its per-tenant bucket
+rate by its share of partition leaderships (`set_leadership_share`,
+pushed by BrokerServer._quota_share_duty), so a tenant producing to
+every leader sums to ~its configured rate regardless of broker count —
+the pre-scaling behavior multiplied the quota by the number of
+partition-leader brokers. The share is floored at one partition's
+worth by the duty: a broker holding ZERO leaderships still admits a
+trickle, so a stale-routed produce draws the proper `not_leader`
+redirect hint instead of an `overloaded:` refusal (admission runs
+before the leadership check). The clock is injectable so tier-1 tests
 drive refill windows with zero real sleeps.
 """
 
@@ -98,6 +104,12 @@ class AdmissionController:
         self._quotas = {str(k): float(v) for k, v in dict(quotas or {}).items()}
         self._tiers = {str(k): str(v) for k, v in dict(tiers or {}).items()}
         self._buckets: dict[str, TokenBucket] = {}
+        # Leadership share: the fraction of the cluster's partition
+        # leaderships this broker holds — each tenant bucket's
+        # effective rate is quota * share, making the quota a CLUSTER
+        # rate instead of a per-broker one. 1.0 until the duty's first
+        # push (single-broker and test shapes keep full rate).
+        self._share = 1.0
         self._shed_level = 0
         # Counters (racy-read snapshot contract, like obs.metrics):
         # written under _lock, read bare by stats().
@@ -111,6 +123,29 @@ class AdmissionController:
     @property
     def shed_level(self) -> int:
         return self._shed_level
+
+    def set_leadership_share(self, share: float) -> None:
+        """Rescale every tenant bucket to `quota * share` (share = this
+        broker's fraction of partition leaderships, pushed by the
+        owning broker's duty pass as leadership moves). Existing
+        buckets rescale IN PLACE — their balance clips to the new
+        burst so a failover that shrinks a broker's share cannot leave
+        a banked full-cluster burst behind; accumulated debt (negative
+        balance) is preserved."""
+        share = max(0.0, min(1.0, float(share)))
+        with self._lock:
+            if share == self._share:
+                return
+            self._share = share
+            for tenant, b in self._buckets.items():
+                rate = self._quotas[tenant] * share
+                b.rate = rate
+                b.burst = max(1.0, rate)
+                b.tokens = min(b.tokens, b.burst)
+
+    @property
+    def leadership_share(self) -> float:
+        return self._share
 
     def set_shed(self, on: bool) -> None:
         """Switch-shaped compatibility surface: on = ladder level 1."""
@@ -159,17 +194,21 @@ class AdmissionController:
                 return None
             b = self._buckets.get(tenant)
             if b is None:
-                b = self._buckets[tenant] = TokenBucket(rate, self._clock())
+                b = self._buckets[tenant] = TokenBucket(
+                    rate * self._share, self._clock()
+                )
             if b.take(max(1, int(n)), self._clock()):
                 return None
             self.quota_refusals += 1
-            return (f"tenant {tenant!r} over its {rate:g} msg/s quota; "
-                    f"retry with backoff")
+            return (f"tenant {tenant!r} over its {rate:g} msg/s cluster "
+                    f"quota (this broker's share "
+                    f"{rate * self._share:g} msg/s); retry with backoff")
 
     def stats(self) -> dict:
         return {
             "shedding": self._shed_level > 0,
             "shed_level": self._shed_level,
+            "leadership_share": self._share,
             "quota_tenants": len(self._quotas),
             "tier_tenants": len(self._tiers),
             "shed_refusals": self.shed_refusals,
