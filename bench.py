@@ -2294,7 +2294,146 @@ def _round_rtt(cfg, samples: int = 8) -> float:
     return float(np.median(ts) * 1e3)
 
 
-def main() -> None:
+# ---------------------------------------------------------------- gate
+# Named headline metrics the `--compare BASELINE.json` regression gate
+# watches, with the direction that counts as better. Everything else in
+# the artifact is context (curves, A/B arms, configs) — the gate only
+# trips on the numbers the README quotes.
+HEADLINE_GATES = (
+    ("value", "higher"),                       # engine sustained rate
+    ("shipped_shape_appends_per_sec", "higher"),
+    ("consume_msgs_per_sec", "higher"),
+    ("codec_mb_per_sec", "higher"),
+    ("stripe_encode_mb_per_sec", "higher"),
+    ("e2e_appends_per_sec", "higher"),
+    ("e2e_consume_msgs_per_sec", "higher"),
+    ("p99_ack_ms", "lower"),
+)
+REGRESSION_PCT = 15.0
+
+
+def _archive_result(result: dict) -> str:
+    """Write the run's artifact next to the historical BENCH_r<NN>.json
+    archives (next free number) so every run leaves a comparable
+    baseline behind — the gate's denominators are never hand-curated."""
+    import os
+    import re
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    taken = [
+        int(m.group(1))
+        for f in os.listdir(root)
+        if (m := re.fullmatch(r"BENCH_r(\d+)\.json", f))
+    ]
+    path = os.path.join(root, "BENCH_r%02d.json" % (max(taken, default=0) + 1))
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def _load_baseline(path: str) -> dict:
+    """A baseline is either a bare bench artifact (what _archive_result
+    writes) or a driver wrapper holding one under `parsed`/`tail`."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "metric" in doc:
+        return doc
+    if isinstance(doc, dict):
+        if isinstance(doc.get("parsed"), dict):
+            return doc["parsed"]
+        tail = doc.get("tail") or ""
+        i = tail.find('{"metric"')
+        if i >= 0:
+            return json.loads(tail[i:])
+        # Front-truncated tail (fixed-size stdout capture cut the
+        # artifact's head off). The cut usually lands inside the first
+        # string value, so re-opening the object with a dummy key
+        # recovers every complete key after the cut point.
+        try:
+            rec = json.loads('{"_truncated": "' + tail)
+        except ValueError:
+            rec = None
+        if isinstance(rec, dict) and any(
+                k in rec for k, _ in HEADLINE_GATES):
+            return rec
+    raise SystemExit(f"--compare: no bench artifact found in {path}")
+
+
+def compare_results(result: dict, baseline: dict,
+                    threshold_pct: float = REGRESSION_PCT) -> list[str]:
+    """Regression gate: every HEADLINE_GATES metric present in BOTH
+    artifacts must not be worse than the baseline by > threshold_pct.
+    Returns the failure lines (empty = gate passes); prints one verdict
+    line per compared metric to stderr."""
+    import sys
+
+    failures: list[str] = []
+    for key, direction in HEADLINE_GATES:
+        if key not in result or key not in baseline:
+            continue
+        cur, base = float(result[key]), float(baseline[key])
+        if base == 0:
+            continue
+        # Positive delta_pct = worse, in either direction's terms.
+        delta = ((base - cur) if direction == "higher" else (cur - base)) \
+            / abs(base) * 100.0
+        worse = delta > threshold_pct
+        print("compare: %-32s %14.3f -> %14.3f  %+7.2f%% %s"
+              % (key, base, cur, -delta if direction == "higher" else delta,
+                 "REGRESSED" if worse else "ok"), file=sys.stderr)
+        if worse:
+            failures.append(
+                f"{key}: {base} -> {cur} "
+                f"({delta:.1f}% worse, limit {threshold_pct}%)")
+    return failures
+
+
+def _operating_curve_main(out_path: str) -> None:
+    """Standalone rails-prior phase: measure the (coalesce, chain_depth)
+    operating curve at the headline latency shape and write an
+    `slo_rails_file` JSON prior — the AIMD controller then starts from
+    this machine's measured knee instead of the shipped rail defaults
+    (slo/controller.py _load_rails).
+
+    Rail derivation from the measured curve: among the light-load
+    service points, the largest coalesce budget whose p99 stays within
+    25% of the measured floor becomes the coalesce rail ceiling; the
+    chain depth of the highest-throughput point (chained points
+    included) becomes the depth ceiling. Floors stay at the latency-
+    favoring end (0 s / depth 1)."""
+    from ripplemq_tpu.core.config import EngineConfig
+
+    lat_cfg = EngineConfig(
+        partitions=1024, replicas=5, slots=2048, slot_bytes=128,
+        max_batch=32, read_batch=32, max_consumers=64, max_offset_updates=8,
+    )
+    curve = _run_curve(lat_cfg)
+    light = [pt for pt in curve if "window" not in pt]
+    floor_p99 = min(pt["p99_ack_ms"] for pt in light)
+    ok_budget = [pt for pt in light
+                 if pt["p99_ack_ms"] <= 1.25 * floor_p99]
+    best = max(curve, key=lambda pt: pt["appends_per_sec"])
+    rails = {
+        "read_coalesce_min_s": 0.0,
+        "read_coalesce_max_s": max(pt["coalesce_s"] for pt in ok_budget),
+        "chain_depth_min": 1,
+        "chain_depth_max": int(best["chain_depth"]),
+    }
+    prior = {
+        "method": "bench.py operating_curve",
+        "floor_p99_ack_ms": floor_p99,
+        "rails": rails,
+        "curve": curve,
+    }
+    with open(out_path, "w") as f:
+        json.dump(prior, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"rails": rails, "floor_p99_ack_ms": floor_p99,
+                      "out": out_path}))
+
+
+def main(compare: "str | None" = None) -> None:
     import jax
 
     from ripplemq_tpu.core.config import EngineConfig
@@ -2412,9 +2551,7 @@ def main() -> None:
     # (workers 1/2/4, subprocess clients everywhere, count-exact).
     host_plane_scaling = _run_host_plane_scaling()
 
-    print(
-        json.dumps(
-            {
+    result = {
                 "metric": "committed_appends_per_sec",
                 "value": round(tpu_rate, 1),
                 "unit": "appends/s",
@@ -2446,9 +2583,17 @@ def main() -> None:
                 **control_plane_storm,
                 **group_consume,
                 **e2e,
-            }
-        )
-    )
+    }
+    print(json.dumps(result))
+    import sys
+
+    print(f"archived -> {_archive_result(result)}", file=sys.stderr)
+    if compare:
+        failures = compare_results(result, _load_baseline(compare))
+        if failures:
+            raise SystemExit(
+                "bench regression gate FAILED:\n  " + "\n  ".join(failures))
+        print("bench regression gate: ok", file=sys.stderr)
 
 
 if __name__ == "__main__":
@@ -2471,5 +2616,18 @@ if __name__ == "__main__":
         # engine work):
         #     python bench.py control_plane_storm
         print(json.dumps(_run_control_plane_storm()))
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "operating_curve":
+        # Standalone rails-prior phase — writes an slo_rails_file JSON
+        # (default slo_rails.json) from the measured operating curve:
+        #     python bench.py operating_curve [OUT.json]
+        _operating_curve_main(
+            _sys.argv[2] if len(_sys.argv) > 2 else "slo_rails.json")
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "--compare":
+        # Full run + regression gate against a prior artifact (exits
+        # nonzero on a >15% regression of any HEADLINE_GATES metric):
+        #     python bench.py --compare BENCH_r05.json
+        if len(_sys.argv) < 3:
+            raise SystemExit("usage: python bench.py --compare BASELINE.json")
+        main(compare=_sys.argv[2])
     else:
         main()

@@ -59,6 +59,8 @@ FAST_MODULES = {
     "test_metadata",
     "test_model_check",
     "test_multichip_smoke",     # tier-1 fused-spmd canary on the 8-dev mesh
+    "test_spans",               # ~25 s: span units + one proc-backend
+                                # acceptance tree (2 workers, striped)
     "test_observability",
     "test_op_split",
     "test_packaging",

@@ -123,10 +123,12 @@ _PID_WINDOW = 8
 
 
 class _Pending:
-    __slots__ = ("payloads", "rows", "future", "rounds_left", "pid", "seq")
+    __slots__ = ("payloads", "rows", "future", "rounds_left", "pid", "seq",
+                 "tctx")
 
     def __init__(self, payloads: list[bytes], future: Future,
-                 rounds_left: int, rows=None, pid: int = 0, seq: int = -1):
+                 rounds_left: int, rows=None, pid: int = 0, seq: int = -1,
+                 tctx=None):
         self.payloads = payloads
         # Appends carry their rows PRE-PACKED (pack_payload_rows on the
         # submitting thread); the drain only memcpys blocks and stamps
@@ -140,6 +142,10 @@ class _Pending:
         # round re-appends under the SAME identity.
         self.pid = pid
         self.seq = seq
+        # Causal-tracing context (obs/spans.py TraceContext) of a
+        # SAMPLED produce, else None: the settle release emits the six
+        # round-stage spans attributed to it.
+        self.tctx = tctx
 
 
 class _PendingOffsets(_Pending):
@@ -178,6 +184,7 @@ class DataPlane:
         obs: bool = True,
         metrics=None,
         recorder=None,
+        spans=None,
     ) -> None:
         self.cfg = cfg
         # --- telemetry plane (obs/) ---------------------------------------
@@ -192,6 +199,11 @@ class DataPlane:
 
         self.metrics = metrics if metrics is not None else Metrics(enabled=obs)
         self.recorder = recorder if recorder is not None else FlightRecorder()
+        # Causal-tracing span ring (obs/spans.py), normally the owning
+        # broker's — and only handed over when tracing is CONFIGURED
+        # (trace_sample_n > 0): `spans is None` gates every per-round
+        # tctx scan below to zero when the plane is untraced.
+        self.spans = spans
         m = self.metrics
         # Hot-path metric handles resolved ONCE (registry lookups lock).
         self._m_submits = m.counter("produce.submits")
@@ -911,7 +923,7 @@ class DataPlane:
     # ------------------------------------------------------------- submits
 
     def submit_append(self, slot: int, payloads: list[bytes],
-                      pid: int = 0, seq: int = -1) -> Future:
+                      pid: int = 0, seq: int = -1, tctx=None) -> Future:
         """Queue payloads for partition `slot`; future resolves to the
         first assigned absolute offset once the round commits.
 
@@ -968,10 +980,11 @@ class DataPlane:
                 TypeError(f"payloads must be bytes: {e}")
             )
             return fut
-        return self._submit_rows(slot, list(payloads), rows, pid, seq, fut)
+        return self._submit_rows(slot, list(payloads), rows, pid, seq, fut,
+                                 tctx)
 
     def submit_packed(self, slot: int, packed, lens: list[int],
-                      pid: int = 0, seq: int = -1) -> Future:
+                      pid: int = 0, seq: int = -1, tctx=None) -> Future:
         """Queue a PRE-PACKED append batch: `packed` is the
         `[len(lens), slot_bytes]` row block a host-plane worker already
         validated and packed (parallel/hostplane.py `_pack_rows`, the
@@ -1006,10 +1019,11 @@ class DataPlane:
         payloads = [
             mv[i * SB + _HDR : i * SB + _HDR + lens[i]] for i in range(k)
         ]
-        return self._submit_rows(slot, payloads, rows, pid, seq, fut)
+        return self._submit_rows(slot, payloads, rows, pid, seq, fut, tctx)
 
     def _submit_rows(self, slot: int, payloads: list, rows,
-                     pid: int, seq: int, fut: Future) -> Future:
+                     pid: int, seq: int, fut: Future,
+                     tctx=None) -> Future:
         """Shared enqueue tail of submit_append / submit_packed (the
         caller validated and packed)."""
         self._m_submits.inc()
@@ -1037,7 +1051,8 @@ class DataPlane:
                 return fut
             self._appends.setdefault(slot, []).append(
                 _Pending(list(payloads), fut, self.max_retry_rounds, rows,
-                         pid=pid, seq=seq)
+                         pid=pid, seq=seq,
+                         tctx=tctx if self.spans is not None else None)
             )
             if pid > 0:
                 # Settled batches are moved to the dedup table — and
@@ -2110,9 +2125,11 @@ class DataPlane:
                 # (async) device launch call. Stamp t_dispatch in the
                 # ctx so the downstream stages (commit fetch, settle
                 # entry, acks, persist, release) measure against it.
-                self._m_dispatch_us.observe(self.metrics.clock() - t_dispatch)
+                t_dispatched = self.metrics.clock()
+                self._m_dispatch_us.observe(t_dispatched - t_dispatch)
                 self._m_chain_rounds.observe_int(live_rounds)
                 ctx["t_dispatch"] = t_dispatch
+                ctx["t_dispatched"] = t_dispatched
                 self.recorder.record(
                     "dispatch", round_seq=self._dispatch_seq,
                     rounds=live_rounds,
@@ -2280,8 +2297,22 @@ class DataPlane:
                              depth=self._settle_q.qsize())
         ticket = exc = None
         if records and self.replicate_begin_fn is not None:
+            tctxs = None
+            if self.spans is not None:
+                # Wire-form trace contexts of the sampled produces in
+                # this round: the replicators stamp them onto their
+                # frames so the standby's apply spans join the trace.
+                # Only the 2-arg call when there IS something to carry —
+                # single-arg replicate_begin_fn stand-ins stay valid.
+                tctxs = [pend.tctx.wire()
+                         for rc in ctx["chain"]
+                         for taken in rc["appends"].values()
+                         for pend, _, _ in taken if pend.tctx is not None]
             try:
-                ticket = self.replicate_begin_fn(records)
+                if tctxs:
+                    ticket = self.replicate_begin_fn(records, tctxs)
+                else:
+                    ticket = self.replicate_begin_fn(records)
             except Exception as e:
                 # Fencing/empty-set refusal at begin: carried into the
                 # window so the release stage fails the entry IN ORDER
@@ -2357,7 +2388,8 @@ class DataPlane:
             self._persist_round(records)
             # Stage 5: local persist (store framing + any strict-mode
             # inline fsync; store.append_us/fsync_us decompose further).
-            self._m_persist_us.observe(self.metrics.clock() - t_acked)
+            t_persist = self.metrics.clock()
+            self._m_persist_us.observe(t_persist - t_acked)
             # ---- DURABLY SETTLED from here: the round is persisted AND
             # standby-acked. Only now may readers see its effects —
             # mirror rows (the _cache_end advance admits cache readers),
@@ -2400,10 +2432,14 @@ class DataPlane:
                                    committed[k], ack=True)
             # Stage 6 (the whole-round number): dispatch → ack release.
             t0 = ctx.get("t_dispatch")
+            t_rel = self.metrics.clock()
             if t0 is not None:
-                self._m_release_us.observe(self.metrics.clock() - t0)
+                self._m_release_us.observe(t_rel - t0)
             self.recorder.record("settle_release", round_seq=ctx["seq"],
                                  records=len(records))
+            if self.spans is not None:
+                self._emit_stage_spans(ctx, t_wait, t_acked, t_persist,
+                                       t_rel)
         except Exception as e:
             from ripplemq_tpu.broker.replication import FencedError
 
@@ -2436,6 +2472,38 @@ class DataPlane:
             with self._lock:
                 self._settle_inflight -= 1
             self._settle_sem.release()
+
+    def _emit_stage_spans(self, ctx: dict, t_wait: float, t_acked: float,
+                          t_persist: float, t_rel: float) -> None:
+        """Emit the six round-stage spans (PR 5's stage boundaries, now
+        ATTRIBUTED) for every sampled batch the settled round carried —
+        usually one; an untraced round costs one tctx scan, and an
+        untraced PLANE (spans is None) never reaches here. All
+        timestamps are metrics.clock() = perf_counter, the span ring's
+        own domain. Stage spans are siblings under the produce path's
+        span (rpc.recv / worker.hop) that submitted the batch."""
+        t0 = ctx.get("t_dispatch")
+        if t0 is None:
+            return
+        tctxs = []
+        for rc in ctx["chain"]:
+            for taken in rc["appends"].values():
+                for pend, _, _ in taken:
+                    if pend.tctx is not None:
+                        tctxs.append(pend.tctx)
+        if not tctxs:
+            return
+        sp = self.spans
+        td = ctx.get("t_dispatched", t0)
+        tc = ctx.get("t_commit", td)
+        te = ctx.get("t_enter", tc)
+        for tctx in tctxs:
+            sp.span_at("engine.dispatch", tctx, t0, td - t0)
+            sp.span_at("settle.commit_wait", tctx, td, tc - td)
+            sp.span_at("settle.enter_wait", tctx, tc, te - tc)
+            sp.span_at("settle.standby_ack", tctx, t_wait, t_acked - t_wait)
+            sp.span_at("settle.persist", tctx, t_acked, t_persist - t_acked)
+            sp.span_at("settle.release", tctx, t0, t_rel - t0)
 
     def _mirror_records(self, records) -> None:
         """Write committed append rows into the host ring mirror at

@@ -52,6 +52,7 @@ from typing import Callable, Optional
 
 from ripplemq_tpu.broker.dataplane import NotCommittedError
 from ripplemq_tpu.obs.lockwitness import make_lock
+from ripplemq_tpu.obs.spans import ctx_from_wire
 from ripplemq_tpu.utils.logs import get_logger
 from ripplemq_tpu.wire.transport import RpcError, Transport
 
@@ -107,14 +108,17 @@ class _Sender(threading.Thread):
         # handles) — the static graph models the alias the same way.
         self._lock = make_lock("_Sender._lock")
         self._cond = threading.Condition(self._lock)
-        self._queue: list[tuple[list, Future]] = []
-        self._buffer: Optional[list[tuple[list, Future]]] = None
+        # Entries are (records, fut, tctxs) — tctxs the wire-form trace
+        # contexts of the round's sampled produces (None when untraced),
+        # stamped onto the frame so standby apply spans join the trace.
+        self._queue: list[tuple[list, Future, Optional[list]]] = []
+        self._buffer: Optional[list] = None
         self._stopped = False
         self.unreachable = False  # consecutive send failures observed
 
     # -- enqueue (any thread) --
 
-    def enqueue(self, records: list) -> Future:
+    def enqueue(self, records: list, tctxs: Optional[list] = None) -> Future:
         """Live round: behind the catch-up stream while buffering."""
         fut: Future = Future()
         with self._cond:
@@ -122,9 +126,9 @@ class _Sender(threading.Thread):
                 fut.set_exception(ReplicationError("sender stopped"))
                 return fut
             if self._buffer is not None:
-                self._buffer.append((records, fut))
+                self._buffer.append((records, fut, tctxs))
             else:
-                self._queue.append((records, fut))
+                self._queue.append((records, fut, tctxs))
                 self._cond.notify()
         return fut
 
@@ -135,7 +139,7 @@ class _Sender(threading.Thread):
             if self._stopped:
                 fut.set_exception(ReplicationError("sender stopped"))
                 return fut
-            self._queue.append((records, fut))
+            self._queue.append((records, fut, None))
             self._cond.notify()
         return fut
 
@@ -150,8 +154,8 @@ class _Sender(threading.Thread):
         with self._cond:
             for q in (self._queue, self._buffer if self._buffer is not None
                       else []):
-                for i, (_, f) in enumerate(q):
-                    if f is fut:
+                for i, entry in enumerate(q):
+                    if entry[1] is fut:
                         del q[i]
                         return True
         return False
@@ -175,29 +179,30 @@ class _Sender(threading.Thread):
             self._queue = []
             self._buffer = None
             self._cond.notify()
-        for _, fut in leftovers:
-            if not fut.done():
-                fut.set_exception(ReplicationError("sender stopped"))
+        for entry in leftovers:
+            if not entry[1].done():
+                entry[1].set_exception(ReplicationError("sender stopped"))
 
     # -- send loop --
 
     def _take_group(self) -> Optional[list]:
-        """Pop one bounded group-commit [(records, fut), ...] off the
-        queue (caller holds self._cond)."""
+        """Pop one bounded group-commit [(records, fut, tctxs), ...] off
+        the queue (caller holds self._cond)."""
         if not self._queue:
             return None
         group = [self._queue.pop(0)]
         nbytes = sum(len(r[3]) for r in group[0][0])
         while (self._queue and len(group) < _GROUP_COMMIT_ROUNDS
                and nbytes < _GROUP_COMMIT_BYTES):
-            recs, _ = self._queue[0]
+            recs = self._queue[0][0]
             nbytes += sum(len(r[3]) for r in recs)
             group.append(self._queue.pop(0))
         return group
 
     @staticmethod
     def _settle_group(group: list, result) -> None:
-        for _, f in group:
+        for entry in group:
+            f = entry[1]
             if not f.done():
                 if isinstance(result, BaseException):
                     f.set_exception(result)
@@ -209,7 +214,7 @@ class _Sender(threading.Thread):
         returns a Future of the response dict (pipelined when the
         transport supports call_async, an already-resolved future
         otherwise — the in-proc network is synchronous by design)."""
-        records = [r for recs, _ in group for r in recs]
+        records = [r for entry in group for r in entry[0]]
         req = {
             "type": "repl.rounds",
             "epoch": epoch,
@@ -217,6 +222,13 @@ class _Sender(threading.Thread):
             "sseq": sseq,
             "records": [[t, s, b, p] for t, s, b, p in records],
         }
+        tctxs = [t for entry in group for t in (entry[2] or ())]
+        if tctxs:
+            # Trace contexts of the frame's sampled produces: the standby
+            # records its repl.apply span under these (server
+            # _handle_repl_rounds), closing the cross-process edge the
+            # assembler's skew estimate keys on.
+            req["tctx"] = tctxs
         if self._rep.floors_fn is not None and records:
             # Piggyback the per-slot settled floor (+ gap map) for the
             # slots this frame touches: the standby publishes it as its
@@ -376,7 +388,7 @@ class _Sender(threading.Thread):
                 inflight.pop(0)
                 failures = 0
                 self.unreachable = False
-                records = [r for recs, _ in group for r in recs]
+                records = [r for entry in group for r in entry[0]]
                 # Group-commit telemetry: rounds per acked frame is the
                 # batching factor the PR 3 sender bought; the frame RPC
                 # time is the raw standby round trip the settle stage's
@@ -484,6 +496,12 @@ class RoundReplicator:
             self._c_records = self._c_frames = self._c_retries = None
             self._c_bytes = None
             self._clock = time.perf_counter
+        # Causal-tracing hook (obs/spans.py): the owning broker sets
+        # this to its SpanRing when trace sampling is configured; begin()
+        # then records one repl.send span per (sampled produce, standby)
+        # covering queue time + frame round trip — the sender-side half
+        # of the replication edge whose standby half is repl.apply.
+        self.spans = None
         self._lock = make_lock("RoundReplicator._lock")
         self._senders: dict[int, _Sender] = {}
         self._joining: set[int] = set()
@@ -545,7 +563,8 @@ class RoundReplicator:
 
     # -- hot path (DataPlane resolver/settle threads) --
 
-    def begin(self, records: list) -> "ReplicationTicket":
+    def begin(self, records: list,
+              tctxs: Optional[list] = None) -> "ReplicationTicket":
         """Enqueue one round's records on every current-set member's
         ordered stream WITHOUT waiting for acks. Returns the ticket
         `wait()` later blocks on — the two halves of `replicate()`, split
@@ -554,7 +573,10 @@ class RoundReplicator:
         then released strictly in round order by `wait`ing the tickets
         in order; see broker/dataplane.py settle pipeline). Raises
         FencedError if deposed, ReplicationError on the empty-set
-        refusal — both BEFORE anything is enqueued."""
+        refusal — both BEFORE anything is enqueued. `tctxs` carries the
+        wire-form trace contexts of the round's sampled produces (see
+        obs/spans.py): stamped onto the outgoing frames and recorded as
+        sender-side repl.send spans that end when the member acks."""
         if not self.active():
             raise FencedError("controller deposed (local metadata)")
         targets = set(self.members_fn())
@@ -579,7 +601,16 @@ class RoundReplicator:
         with self._lock:
             targets |= self._joining
         senders = {bid: self._sender(bid) for bid in targets}
-        futs = {bid: s.enqueue(records) for bid, s in senders.items()}
+        futs = {bid: s.enqueue(records, tctxs)
+                for bid, s in senders.items()}
+        if tctxs and self.spans is not None:
+            for raw in tctxs:
+                ctx = ctx_from_wire(raw)
+                if ctx is None:
+                    continue
+                for bid, fut in futs.items():
+                    sp = self.spans.span("repl.send", ctx, {"standby": bid})
+                    fut.add_done_callback(lambda _f, s=sp: s.end())
         return ReplicationTicket(records, senders, futs, time.monotonic())
 
     def replicate(self, records: list,

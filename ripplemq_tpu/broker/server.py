@@ -45,6 +45,13 @@ from typing import Callable, Optional
 
 from ripplemq_tpu.broker.dataplane import DataPlane, NotCommittedError
 from ripplemq_tpu.obs.lockwitness import make_lock
+from ripplemq_tpu.obs.spans import (
+    NULL_SPAN,
+    TraceContext,
+    ctx_from_wire,
+    derive_trace_id,
+    sampled,
+)
 from ripplemq_tpu.broker.hostraft import LEADER, RAFT_TYPES, RaftNode, RaftRunner
 from ripplemq_tpu.broker.manager import (
     OP_BATCH,
@@ -270,6 +277,20 @@ class BrokerServer:
 
         self.metrics = Metrics(enabled=config.obs)
         self.recorder = FlightRecorder()
+        # Causal tracing plane (obs/spans.py): one span ring per broker
+        # process, serving admin.spans. None when trace_sample_n=0 —
+        # every emit site below gates on `self.spans is not None` (or
+        # on a None ctx), so the untraced hot path never reads a clock.
+        # The ring shares the metrics clock so the engine's round-stage
+        # timestamps can be recorded as spans verbatim (same monotonic
+        # domain; trace_sample_n > 0 requires obs=True at parse time).
+        from ripplemq_tpu.obs.spans import SpanRing
+        self.spans = (
+            SpanRing(f"broker{broker_id}",
+                     capacity=config.span_ring_slots,
+                     clock=self.metrics.clock)
+            if config.trace_sample_n > 0 else None
+        )
         # Produce-ack latency as the CLIENT of this broker experiences
         # it (admission → all pipelined rounds settled), observed in
         # _handle_produce. This is the SLO controller's plant output:
@@ -490,6 +511,7 @@ class BrokerServer:
                 max_batch=config.engine.max_batch,
                 ring_bytes=config.host_ring_bytes,
                 recorder=self.recorder,
+                spans=self.spans,
             )
         # Pipelined replication stream gate (see _ReplStreamGate): the
         # standby side of repl.rounds applies frames in per-stream
@@ -738,6 +760,7 @@ class BrokerServer:
                 obs=self.config.obs,
                 metrics=self.metrics,
                 recorder=self.recorder,
+                spans=self.spans,
             )
             if image is not None:
                 dp.install(image, settled_gaps=gaps, pid_table=pid_tab)
@@ -818,6 +841,7 @@ class BrokerServer:
         pipeline uses to keep a window of rounds streaming to the
         standbys while the device advances (dataplane settle pipeline)."""
         rep = self._make_replicator()
+        rep.spans = self.spans
         dp.replicate_fn = rep.replicate
         dp.replicate_begin_fn = rep.begin
         dp.replicate_wait_fn = rep.wait
@@ -1034,8 +1058,12 @@ class BrokerServer:
                 return self._handle_stats(req)
             if t == "admin.metrics":
                 return self._handle_metrics(req)
+            if t == "admin.metrics_text":
+                return self._handle_metrics_text(req)
             if t == "admin.trace":
                 return self._handle_trace(req)
+            if t == "admin.spans":
+                return self._handle_spans(req)
             if t == "admin.postmortem":
                 from ripplemq_tpu.obs.postmortem import collect_postmortem
 
@@ -1082,12 +1110,54 @@ class BrokerServer:
             out["engine_metrics"] = dp.metrics.snapshot()
         return out
 
+    def _handle_metrics_text(self, req: dict) -> dict:
+        """Prometheus-style text exposition of the SAME registry
+        admin.metrics snapshots (obs/metrics.py render_prometheus):
+        counters as `_total`, gauges bare, histograms as cumulative
+        log2 `_bucket{le=...}` series with `_sum`/`_count`. One string
+        under "text" so both transports carry it as an ordinary
+        response field; scrape adapters write it out verbatim."""
+        from ripplemq_tpu.obs.metrics import render_prometheus
+
+        text = render_prometheus(self.metrics)
+        dp = self._local_engine()
+        if dp is not None and dp.metrics is not self.metrics:
+            text += render_prometheus(dp.metrics)
+        return {"ok": True, "text": text}
+
+    def _handle_spans(self, req: dict) -> dict:
+        """Paged span-ring read (obs/spans.py), the collection half of
+        the causal-tracing plane. Same paging contract as stripe.fetch:
+        `after` is the last seq the caller saw (-1 from cold),
+        `max_spans` bounds the page, and the response's `cursor` is the
+        last served record's seq (== `after` when the page is empty).
+        Rings are racy-consistent; assemblers page until the cursor
+        stops moving. trace_sample_n=0 serves empty pages, not errors."""
+        after = int(req.get("after", -1))
+        if self.spans is None:
+            return {"ok": True, "spans": [], "cursor": after}
+        max_spans = req.get("max_spans")
+        recs = self.spans.snapshot(
+            after=after,
+            max_spans=int(max_spans) if max_spans is not None else None,
+        )
+        return {
+            "ok": True,
+            "spans": recs,
+            "cursor": recs[-1]["seq"] if recs else after,
+        }
+
     def _handle_trace(self, req: dict) -> dict:
         """The flight-recorder window (obs/trace.py), oldest first;
         `last` clips to the most recent N events."""
         last = req.get("last")
         last = int(last) if last is not None else None
-        out = {"ok": True, "trace": self.recorder.snapshot(last=last)}
+        # `now` is this broker's wall clock at snapshot time: the chaos
+        # timeline merge pairs it with the caller's send/receive stamps
+        # (NTP midpoint) to estimate per-broker clock skew instead of
+        # trusting raw wall-clock event ordering across processes.
+        out = {"ok": True, "trace": self.recorder.snapshot(last=last),
+               "now": time.time()}
         dp = self._local_engine()
         if dp is not None and dp.recorder is not self.recorder:
             out["engine_trace"] = dp.recorder.snapshot(last=last)
@@ -1861,7 +1931,19 @@ class BrokerServer:
                     return
                 self._last_wave = time.monotonic()
                 cmds = [c for c, _ in batch]
+                # Metadata-plane traces are op-identity rooted (no
+                # client carried a ctx here): the wave ordinal seeds the
+                # same deterministic sampling predicate the clients use.
+                wsp = NULL_SPAN
+                if self.spans is not None:
+                    tid = derive_trace_id(f"wave/broker{self.broker_id}",
+                                          self._wave_count)
+                    if sampled(tid, self.config.trace_sample_n):
+                        wsp = self.spans.span("meta.wave",
+                                              TraceContext(tid, 0),
+                                              {"size": len(cmds)})
                 ok = self.propose_cmd({"op": OP_BATCH, "cmds": cmds})
+                wsp.end(ok=ok)
                 self._wave_count += 1
                 self._wave_events += len(cmds)
                 if not ok:
@@ -2067,21 +2149,37 @@ class BrokerServer:
         the p99 the SLO controller steers against."""
         messages = req.get("messages")
         n = len(messages) if isinstance(messages, list) else 1
+        # Causal tracing: a sampled produce carries `tctx` (the client
+        # root span's context); rpc.recv covers this broker's whole
+        # handling, admission its front-door slice. Unsampled requests
+        # (no tctx, or tracing off) pay one dict-get and a None branch.
+        sp = (self.spans.span("rpc.recv", ctx_from_wire(req.get("tctx")),
+                              {"op": "produce"})
+              if self.spans is not None else NULL_SPAN)
+        asp = (self.spans.span("admission", sp.ctx)
+               if sp.ctx is not None else NULL_SPAN)
         refusal = self.slo.admit(req.get("producer"), n)
+        asp.end()
         if refusal is not None:
+            sp.end(error="overloaded")
             return {"ok": False, "error": f"overloaded: {refusal}"}
         t0 = self.metrics.clock()
         try:
-            return self._produce_admitted(req)
+            return self._produce_admitted(req, tctx=sp.ctx)
         finally:
             self._m_ack_us.observe(self.metrics.clock() - t0)
+            sp.end()
 
     # Fields the raw-dispatch peek materializes: the routing/admission
     # scalars (including the elastic-partition fence/routing stamps
     # pgen + key_hash) plus the message VECTOR's element count (never
-    # its bytes).
+    # its bytes). `tctx` is peeked only to DETECT a sampled produce
+    # (lists peek as element counts, not values): sampled frames take
+    # the canonical decode path below, where the full trace context is
+    # materialized — at trace_sample_n-th cadence the one extra decode
+    # is exactly the kind of overhead sampling exists to amortize.
     _RAW_PEEK = ("type", "topic", "partition", "producer", "pid", "seq",
-                 "pgen", "key_hash", "messages")
+                 "pgen", "key_hash", "messages", "tctx")
 
     def _raw_produce(self, body) -> Optional[dict]:
         """Raw-frame produce dispatch (TcpServer accept path, host-plane
@@ -2097,6 +2195,8 @@ class BrokerServer:
         peek = codec.peek_fields(body, self._RAW_PEEK)
         if peek is None or peek.get("type") != "produce":
             return None
+        if peek.get("tctx") is not None:
+            return None  # sampled: canonical path records the spans
         n = peek.get("messages")
         if not isinstance(n, int) or n <= 0:
             return None  # empty/odd batch: canonical path refuses it
@@ -2112,7 +2212,8 @@ class BrokerServer:
         finally:
             self._m_ack_us.observe(self.metrics.clock() - t0)
 
-    def _produce_admitted(self, req: dict, raw=None, raw_count: int = 0) -> dict:
+    def _produce_admitted(self, req: dict, raw=None, raw_count: int = 0,
+                          tctx=None) -> dict:
         """Produce semantics: at-least-once by default, EXACTLY-ONCE for
         idempotent producers. A batch larger than max_batch is split into
         pipelined rounds, and some rounds can fail while others commit (a
@@ -2184,6 +2285,12 @@ class BrokerServer:
                 WorkerUnavailableError,
             )
 
+            # worker.hop: the broker-side shm-ring round trip; the
+            # worker's serve/validate/stamp/pack spans parent under it
+            # (hop.ctx rides the ring frame) and ship back inside the
+            # response for the broker ring to adopt.
+            hop = (self.spans.span("worker.hop", tctx)
+                   if self.spans is not None else NULL_SPAN)
             try:
                 if raw is not None:
                     stamped = self.hostplane.submit_raw(
@@ -2196,8 +2303,11 @@ class BrokerServer:
                         slot, messages,
                         pid=req.get("pid"), seq=req.get("seq"),
                         timeout_s=self.config.rpc_timeout_s,
+                        tctx=None if hop.ctx is None else hop.ctx.wire(),
                     )
+                hop.end()
             except WorkerUnavailableError as e:
+                hop.end(error="worker_unavailable")
                 # Typed RETRYABLE refusal — never a silent hang: the
                 # dispatcher already detected the dead worker and is
                 # respawning it; the client's retry lands.
@@ -2227,6 +2337,7 @@ class BrokerServer:
                 self._engine_append_packed(
                     slot, lens, packed, pid,
                     seq + i * B if pid > 0 else -1,
+                    tctx=tctx,
                 )
                 for i, (lens, packed) in enumerate(stamped["chunks"])
             ]
@@ -2242,6 +2353,7 @@ class BrokerServer:
                 self._engine_append(
                     slot, chunk, pid,
                     seq + i * B if pid > 0 else -1,
+                    tctx=tctx,
                 )
                 for i, chunk in enumerate(chunks)
             ]
@@ -2290,12 +2402,16 @@ class BrokerServer:
         `consume.ack_us`, the p99 the SLO controller's consume twin
         steers toward slo_p99_consume_ms (via read_coalesce_s)."""
         t0 = self.metrics.clock()
+        sp = (self.spans.span("rpc.recv", ctx_from_wire(req.get("tctx")),
+                              {"op": "consume"})
+              if self.spans is not None else NULL_SPAN)
         try:
-            return self._consume_checked(req)
+            return self._consume_checked(req, tctx=sp.ctx)
         finally:
+            sp.end()
             self._m_consume_ack_us.observe(self.metrics.clock() - t0)
 
-    def _consume_checked(self, req: dict) -> dict:
+    def _consume_checked(self, req: dict, tctx=None) -> dict:
         key = group_key(req["topic"], req["partition"])
         refusal = self._gen_refusal(req, key)
         if refusal:
@@ -2310,7 +2426,8 @@ class BrokerServer:
             # `not_settled_here:` and the client falls back to the
             # leader named in the ordinary hint.
             if req.get("follower_ok") and req.get("offset") is not None:
-                answer = self._follower_consume(key, req, refusal)
+                answer = self._follower_consume(key, req, refusal,
+                                                tctx=tctx)
                 if answer is not None:
                     return answer
             return refusal
@@ -2346,8 +2463,8 @@ class BrokerServer:
         return {"ok": True, "messages": msgs, "offset": offset,
                 "next_offset": next_offset}
 
-    def _follower_consume(self, key, req: dict,
-                          not_leader: dict) -> Optional[dict]:
+    def _follower_consume(self, key, req: dict, not_leader: dict,
+                          tctx=None) -> Optional[dict]:
         """Serve a consume from the follower read plane, or None when
         this broker is not in a position to even try (feature off, no
         lease, stale generation) — the caller then answers the ordinary
@@ -2371,6 +2488,8 @@ class BrokerServer:
             return {"ok": False, "error": "bad_request: negative offset"}
         limit = req.get("max_messages")
         limit = None if limit is None else int(limit)
+        fsp = (self.spans.span("follower.serve", tctx, {"slot": slot})
+               if self.spans is not None else NULL_SPAN)
         got = None
         if self.hostplane is not None:
             # Shared fan-out on the worker plane: the owning worker's
@@ -2384,7 +2503,17 @@ class BrokerServer:
                     and fp.validate_window(slot, offset, mirror[1])):
                 got = mirror
         if got is None:
+            # A cold striped page pays a reconstruct inside fp.read —
+            # attribute it (decoded-counter delta detects one) as a
+            # child of follower.serve.
+            dec0 = fp._decoded
+            t0r = self.metrics.clock()
             got = fp.read(slot, offset, limit)
+            if fsp.ctx is not None and fp._decoded > dec0:
+                self.spans.span_at(
+                    "stripe.reconstruct", fsp.ctx, t0r,
+                    self.metrics.clock() - t0r,
+                    {"groups": fp._decoded - dec0})
         # Last-line witness: EVERY answer (mirror or cache) re-checks
         # against the floor at the boundary, independent of the serving
         # path's own fence — a failed audit refuses and is counted as
@@ -2392,6 +2521,7 @@ class BrokerServer:
         if got is not None and not fp.audit_answer(slot, offset, got[1]):
             got = None
         if got is None:
+            fsp.end(error="not_settled_here")
             return {
                 "ok": False,
                 "error": f"not_settled_here: slot {slot} offset {offset} "
@@ -2400,6 +2530,7 @@ class BrokerServer:
                 "leader_addr": not_leader.get("leader_addr"),
             }
         msgs, next_offset = got
+        fsp.end(rows=len(msgs))
         return {"ok": True, "messages": msgs, "offset": offset,
                 "next_offset": next_offset, "follower": True}
 
@@ -2937,16 +3068,24 @@ class BrokerServer:
                 )
 
     def _engine_append(self, slot: int, messages: list[bytes],
-                       pid: int = 0, seq: int = -1) -> Callable[[], int]:
+                       pid: int = 0, seq: int = -1,
+                       tctx=None) -> Callable[[], int]:
         """Returns a waiter so multi-chunk produces pipeline their rounds
         (both paths submit WITHOUT blocking: local futures, or pipelined
-        RPC frames when a TcpClient with call_async is underneath)."""
+        RPC frames when a TcpClient with call_async is underneath).
+        `tctx` (a sampled produce's TraceContext) rides into the local
+        plane's pending entry — the settle release emits the six stage
+        spans under it — or onto the forwarded engine.append frame for
+        the controller to do the same."""
         dp = self._local_engine()
         if dp is not None:
-            fut = dp.submit_append(slot, messages, pid=pid, seq=seq)
+            fut = dp.submit_append(slot, messages, pid=pid, seq=seq,
+                                   tctx=tctx)
             return lambda: int(fut.result(timeout=self.config.rpc_timeout_s))
         req = {"type": "engine.append", "slot": slot, "messages": messages,
                "pid": pid, "seq": seq}
+        if tctx is not None:
+            req["tctx"] = tctx.wire()
         call_async = getattr(self.client, "call_async", None)
         if call_async is None:  # in-proc transport: synchronous by design
             resp = self._engine_call(req)
@@ -2964,8 +3103,8 @@ class BrokerServer:
         return wait
 
     def _engine_append_packed(self, slot: int, lens: list[int], packed,
-                              pid: int = 0, seq: int = -1
-                              ) -> Callable[[], int]:
+                              pid: int = 0, seq: int = -1,
+                              tctx=None) -> Callable[[], int]:
         """The pre-packed twin of _engine_append: the host-plane worker
         already validated + packed the rows, so the local path hands the
         block to DataPlane.submit_packed and the forwarded path ships it
@@ -2973,11 +3112,14 @@ class BrokerServer:
         leader→controller hop exactly once, in engine row format."""
         dp = self._local_engine()
         if dp is not None:
-            fut = dp.submit_packed(slot, packed, lens, pid=pid, seq=seq)
+            fut = dp.submit_packed(slot, packed, lens, pid=pid, seq=seq,
+                                   tctx=tctx)
             return lambda: int(fut.result(timeout=self.config.rpc_timeout_s))
         req = {"type": "engine.append_packed", "slot": slot,
                "lens": list(lens), "packed": packed,
                "pid": pid, "seq": seq}
+        if tctx is not None:
+            req["tctx"] = tctx.wire()
         call_async = getattr(self.client, "call_async", None)
         if call_async is None:  # in-proc transport: synchronous by design
             resp = self._engine_call(req)
@@ -3177,25 +3319,37 @@ class BrokerServer:
         if dp is None:
             return {"ok": False, "error": "not_controller",
                     "controller_addr": self._controller_addr()}
-        if t == "engine.append":
-            fut = dp.submit_append(
-                int(req["slot"]), list(req["messages"]),
-                pid=int(req.get("pid", 0) or 0),
-                seq=int(req.get("seq", -1) if req.get("seq") is not None
-                        else -1),
-            )
-            return {"ok": True,
-                    "base_offset": int(fut.result(self.config.rpc_timeout_s))}
-        if t == "engine.append_packed":
-            fut = dp.submit_packed(
-                int(req["slot"]), req["packed"],
-                [int(x) for x in req["lens"]],
-                pid=int(req.get("pid", 0) or 0),
-                seq=int(req.get("seq", -1) if req.get("seq") is not None
-                        else -1),
-            )
-            return {"ok": True,
-                    "base_offset": int(fut.result(self.config.rpc_timeout_s))}
+        if t in ("engine.append", "engine.append_packed"):
+            # Forwarded append from a non-controller leader: a sampled
+            # produce's tctx rode the frame — the controller's rpc.recv
+            # span closes the leader→controller cross-process edge and
+            # parents the engine stage spans (settle release emits them
+            # under the pending entry's tctx).
+            sp = (self.spans.span("rpc.recv", ctx_from_wire(req.get("tctx")),
+                                  {"op": t})
+                  if self.spans is not None else NULL_SPAN)
+            try:
+                if t == "engine.append":
+                    fut = dp.submit_append(
+                        int(req["slot"]), list(req["messages"]),
+                        pid=int(req.get("pid", 0) or 0),
+                        seq=int(req.get("seq", -1)
+                                if req.get("seq") is not None else -1),
+                        tctx=sp.ctx,
+                    )
+                else:
+                    fut = dp.submit_packed(
+                        int(req["slot"]), req["packed"],
+                        [int(x) for x in req["lens"]],
+                        pid=int(req.get("pid", 0) or 0),
+                        seq=int(req.get("seq", -1)
+                                if req.get("seq") is not None else -1),
+                        tctx=sp.ctx,
+                    )
+                return {"ok": True, "base_offset":
+                        int(fut.result(self.config.rpc_timeout_s))}
+            finally:
+                sp.end()
         if t == "engine.read":
             limit = req.get("max_msgs")
             msgs, end = self._engine_read(
@@ -3266,6 +3420,14 @@ class BrokerServer:
                                  "frame missing; rewind onto expected",
                         "expected": self._repl_gate.expected(gate_key)}
         recs = [(int(t), int(s), int(b), p) for t, s, b, p in req["records"]]
+        # Standby-side apply spans: one repl.apply per sampled produce
+        # whose tctx rode the frame — the cross-process child the
+        # assembler pairs with the sender's repl.send for this edge's
+        # clock-skew estimate.
+        sps = ([self.spans.span("repl.apply", ctx_from_wire(raw),
+                                {"records": len(recs)})
+                for raw in req.get("tctx", ())]
+               if self.spans is not None else ())
         append_many = getattr(store, "append_many", None)
         if append_many is not None:
             append_many(recs)  # one batched write per frame (group commit)
@@ -3274,6 +3436,8 @@ class BrokerServer:
                 store.append(*rec)
         if gate_key is not None:
             self._repl_gate.applied(gate_key, sseq)
+        for s in sps:
+            s.end()
         fp = self.follower_plane
         if fp is not None:
             # Feed the follower read plane: this frame's rows plus the
@@ -3349,12 +3513,21 @@ class BrokerServer:
             recs.append(
                 (REC_STRIPE, frame.idx, int(frame.gsn) & 0x7FFFFFFF, raw)
             )
+        # Holder-side apply spans (stripe.apply), one per sampled
+        # produce whose tctx rode the batch — pairs with the sender's
+        # stripe.send for the skew estimate on this edge.
+        sps = ([self.spans.span("stripe.apply", ctx_from_wire(raw),
+                                {"frames": len(frames)})
+                for raw in req.get("tctx", ())]
+               if self.spans is not None else ())
         append_many = getattr(store, "append_many", None)
         if append_many is not None:
             append_many(recs)
         else:
             for rec in recs:
                 store.append(*rec)
+        for s in sps:
+            s.end()
         fp = self.follower_plane
         if fp is not None:
             # Feed the follower read plane's own-stripe window + gsn
@@ -3583,10 +3756,20 @@ class BrokerServer:
             if dp.settled_end(slot) < int(ho["watermark"]) \
                     and not timed_out:
                 continue
-            if self.propose_cmd({
+            csp = NULL_SPAN
+            if self.spans is not None:
+                tid = derive_trace_id(f"cutover/{topic}/{pid}",
+                                      int(ho["watermark"]))
+                if sampled(tid, self.config.trace_sample_n):
+                    csp = self.spans.span(
+                        "meta.cutover", TraceContext(tid, 0),
+                        {"topic": topic, "partition": pid})
+            ok = self.propose_cmd({
                 "op": OP_SPLIT_CUTOVER, "topic": topic,
                 "partition": pid, "watermark": int(ho["watermark"]),
-            }, retries=1) and timed_out:
+            }, retries=1)
+            csp.end(ok=ok)
+            if ok and timed_out:
                 log.warning(
                     "broker %d: split cutover for %s/%d forced by "
                     "handoff timeout (settled %d < watermark %d)",

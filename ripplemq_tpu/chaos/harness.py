@@ -219,16 +219,22 @@ def _drain_partition(cluster: InProcCluster, topic: str, pid: int,
     return out
 
 
-def _collect_broker_obs(cluster) -> tuple[dict[str, dict], list[dict]]:
+def _collect_broker_obs(
+    cluster,
+) -> tuple[dict[str, dict], dict[str, list[dict]], dict[str, float]]:
     """Pull one admin.postmortem bundle per reachable broker (both
     backends reach it over their real transport — the RPC surface is
     the point: what an operator would collect, not an in-proc reach-in)
-    and flatten the bundles' flight-recorder windows into timeline
-    events tagged with their source broker. Unreachable/killed brokers
-    are skipped, not fatal — a postmortem that fails because half the
-    cluster is down must still report the surviving half."""
+    plus each broker's flight-recorder window as a per-source event
+    STREAM (kept in the ring's seq order, never re-sorted here) and a
+    per-source wall-clock skew estimate: the admin.trace response's
+    `now` paired NTP-style against this process's send/receive stamps.
+    Unreachable/killed brokers are skipped, not fatal — a postmortem
+    that fails because half the cluster is down must still report the
+    surviving half."""
     postmortems: dict[str, dict] = {}
-    events: list[dict] = []
+    streams: dict[str, list[dict]] = {}
+    skews: dict[str, float] = {}
     client = cluster.client("obs-collect")
     for bid in cluster.brokers:
         addr = cluster.broker_addr(bid)
@@ -247,13 +253,55 @@ def _collect_broker_obs(cluster) -> tuple[dict[str, dict], list[dict]]:
         # whose device-fetching postmortem wedged is the one whose
         # lifecycle events the timeline most needs.
         try:
+            t_send = time.time()
             tr = client.call(addr, {"type": "admin.trace"}, timeout=15.0)
+            t_recv = time.time()
         except Exception:
             continue
         if tr.get("ok"):
-            for ev in tr.get("trace", []) + tr.get("engine_trace", []):
-                events.append({"src": f"broker{bid}", **ev})
-    return postmortems, events
+            skew = None
+            if tr.get("now") is not None:
+                skew = float(tr["now"]) - (t_send + t_recv) / 2
+            # Broker and engine recorders are separate rings with
+            # independent seq spaces — separate streams, shared skew.
+            for field, tag in (("trace", ""), ("engine_trace", "/engine")):
+                evs = tr.get(field)
+                if not evs:
+                    continue
+                src = f"broker{bid}{tag}"
+                streams[src] = [{"src": src, **ev} for ev in evs]
+                if skew is not None:
+                    skews[src] = skew
+    return postmortems, streams, skews
+
+
+def merge_timeline(streams: dict[str, list[dict]],
+                   skews: Optional[dict[str, float]] = None) -> list[dict]:
+    """Causal timeline merge. Each stream (one broker's flight-recorder
+    ring, the nemesis's fault log) arrives in its OWN emit order —
+    per-source monotone seq numbers / append order — and is NEVER
+    reordered internally: a broker whose wall clock stepped backwards
+    mid-run still reports its own transitions in causal order. ACROSS
+    streams, the next event is the stream head with the smallest
+    skew-corrected timestamp (`t - skews[src]`, the collector-relative
+    offset _collect_broker_obs estimated). The previous merge was a raw
+    wall-clock sort of the union, which under proc-backend clock skew
+    interleaved causally-ordered events backwards — the exact failure
+    mode the span plane's no-wall-clock rule exists for. Each merged
+    event gains `tc`, its skew-corrected (collector-domain) timestamp."""
+    skews = skews or {}
+    heads = {src: 0 for src in streams}
+    out: list[dict] = []
+    while True:
+        live = [s for s, i in heads.items() if i < len(streams[s])]
+        if not live:
+            return out
+        src = min(live, key=lambda s: (
+            streams[s][heads[s]].get("t", 0.0) - skews.get(s, 0.0), s))
+        ev = streams[src][heads[src]]
+        heads[src] += 1
+        out.append({**ev, "tc": round(
+            ev.get("t", 0.0) - skews.get(src, 0.0), 6)})
 
 
 def _collect_slo_stats(cluster) -> dict[str, dict]:
@@ -971,16 +1019,31 @@ def run_chaos(
         # postmortem bundles + the merged fault-vs-lifecycle timeline);
         # clean runs collect only on request.
         postmortems: dict[str, dict] = {}
-        broker_events: list[dict] = []
+        broker_streams: dict[str, list[dict]] = {}
+        broker_skews: dict[str, float] = {}
         if violations or include_postmortems or include_timeline:
-            postmortems, broker_events = _collect_broker_obs(cluster)
+            postmortems, broker_streams, broker_skews = \
+                _collect_broker_obs(cluster)
         if violations or include_timeline:
-            verdict["timeline"] = sorted(
-                list(nemesis.timeline) + broker_events,
-                key=lambda e: e.get("t", 0.0),
+            # Causal merge (merge_timeline): per-source seq order held,
+            # cross-source interleave by skew-corrected wall clock —
+            # never a raw wall-clock sort of the union.
+            verdict["timeline"] = merge_timeline(
+                {"nemesis": list(nemesis.timeline), **broker_streams},
+                broker_skews,
             )
         if violations or include_postmortems:
             verdict["postmortems"] = postmortems
+            # Sampled causal traces, assembled: every postmortem bundle
+            # carries its broker's span ring; joined by trace id they
+            # reassemble into critical-path trees (obs/assemble.py).
+            # Empty when the run had tracing off.
+            span_records = [r for pm in postmortems.values()
+                            for r in pm.get("spans") or ()]
+            if span_records:
+                from ripplemq_tpu.obs.assemble import assemble
+
+                verdict["traces"] = assemble(span_records)[:10]
         if group_workload is not None:
             verdict["group"] = {"members": groups, **group_verdict}
         net = getattr(cluster, "net", None)

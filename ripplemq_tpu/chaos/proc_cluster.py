@@ -156,6 +156,13 @@ def _config_yaml_dict(config: ClusterConfig) -> dict:
         "linearizable_reads": config.linearizable_reads,
         "obs": config.obs,
         "lock_witness": config.lock_witness,
+        # Causal tracing: sampling cadence and ring sizing must
+        # round-trip — a proc-backend broker that silently ran
+        # trace_sample_n=0 would record no spans and the acceptance
+        # tree would mysteriously miss every broker-side hop.
+        "trace_sample_n": config.trace_sample_n,
+        "span_ring_slots": config.span_ring_slots,
+        "slo_rails_file": config.slo_rails_file,
         # SLO autopilot (the control loop must run the same operating
         # point on the subprocess backend as in-proc — the exact drop
         # class the config_plumbing lint exists to prevent).

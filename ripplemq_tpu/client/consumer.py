@@ -40,10 +40,18 @@ server-tracked offset table.
 
 from __future__ import annotations
 
+import itertools
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
+from ripplemq_tpu.obs.spans import (
+    NULL_SPAN,
+    SpanRing,
+    TraceContext,
+    derive_trace_id,
+    sampled,
+)
 from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
 from ripplemq_tpu.wire.retry import RetryPolicy, fatal_response_error
 from ripplemq_tpu.wire.transport import RpcError, TcpClient, Transport
@@ -73,6 +81,7 @@ class ConsumerClient:
         prefetch: int = 0,
         long_poll_s: float = 0.0,
         follower_reads: bool = False,
+        trace_sample_n: int = 0,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -100,6 +109,17 @@ class ConsumerClient:
         # errors surface and close() can flush).
         self._pf: dict[tuple[str, int], dict] = {}
         self._commits: dict[tuple[str, int], tuple[int, object, str]] = {}
+        # Causal tracing (obs/spans.py), mirroring ProducerClient: every
+        # trace_sample_n-th consume opens a client.consume root span
+        # whose context rides `tctx` on the sync and follower fetches
+        # (prefetched fetches were armed before this call existed, so
+        # they stay unstamped). `spans` is public for the assembler.
+        self._trace_sample_n = int(trace_sample_n)
+        self._trace_counter = itertools.count()
+        self.spans: Optional[SpanRing] = (
+            SpanRing(consumer_id) if self._trace_sample_n > 0 else None
+        )
+        self._trace_root = NULL_SPAN  # current call's root (single-threaded)
         # Unified retry discipline (wire/retry.py): jittered exponential
         # backoff, optional per-operation deadline budget.
         self._retry = retry_policy or RetryPolicy(
@@ -139,6 +159,15 @@ class ConsumerClient:
         alignment), so `offset + len(messages)` is NOT a valid position."""
         limit = self.max_messages if max_messages is None else max_messages
         self.last_from_follower = False
+        root = NULL_SPAN
+        if self.spans is not None:
+            tid = derive_trace_id(self.consumer_id,
+                                  next(self._trace_counter))
+            if sampled(tid, self._trace_sample_n):
+                root = self.spans.span("client.consume",
+                                       TraceContext(tid, 0),
+                                       {"topic": topic})
+        self._trace_root = root
         call_async = getattr(self._transport, "call_async", None)
         if self.prefetch > 0 and call_async is not None:
             # Pin the round-robin choice ONCE per call: the prefetch
@@ -153,6 +182,7 @@ class ConsumerClient:
                     partition = self._selector.select(t)
             got = self._consume_prefetched(topic, partition, limit, call_async)
             if got is not None:
+                root.end(n=len(got[0]))
                 return got
         if self.follower_reads:
             if partition is None:
@@ -163,6 +193,7 @@ class ConsumerClient:
                     partition = self._selector.select(t)
             got = self._consume_follower(topic, partition, limit, call_async)
             if got is not None:
+                root.end(n=len(got[0]))
                 return got
         run = self._retry.begin()
         while run.attempt():
@@ -180,23 +211,33 @@ class ConsumerClient:
             # A readahead fallback must not race its own unflushed
             # commits: the server-tracked offset lags until they apply.
             self._flush_commit_key(topic, pid)
+            req = {"type": "consume", "topic": topic, "partition": pid,
+                   "consumer": self.consumer_id, "max_messages": limit}
+            # Per-ATTEMPT client.rpc span (its id rides as tctx): the
+            # broker's rpc.recv pairs with the wire round trip for the
+            # skew estimate, not with the retry loop (producer twin).
+            rpc = NULL_SPAN if self.spans is None else \
+                self.spans.span("client.rpc", root.ctx)
+            if rpc.ctx is not None:
+                req["tctx"] = rpc.ctx.wire()
             try:
                 resp = self._transport.call(
-                    addr,
-                    {"type": "consume", "topic": topic, "partition": pid,
-                     "consumer": self.consumer_id, "max_messages": limit},
-                    timeout=run.clip(self._timeout),
+                    addr, req, timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
+                rpc.end(error=type(e).__name__)
                 run.note(str(e))
                 self._refresh_quietly()
                 continue
+            rpc.end()
             if resp.get("ok"):
                 msgs = list(resp["messages"])
                 offset = int(resp["offset"])
                 next_offset = int(resp.get("next_offset", offset))
-                return self._deliver(topic, pid, addr, limit, call_async,
-                                     msgs, offset, next_offset)
+                got = self._deliver(topic, pid, addr, limit, call_async,
+                                    msgs, offset, next_offset)
+                root.end(n=len(msgs))
+                return got
             err = str(resp.get("error", ""))
             run.note(err)
             if err == "not_leader":
@@ -258,16 +299,19 @@ class ConsumerClient:
         # Same guard as the sync path: an explicit-offset read must not
         # race this partition's own unflushed async commit.
         self._flush_commit_key(topic, pid)
+        req = {"type": "consume", "topic": topic, "partition": pid,
+               "consumer": self.consumer_id, "max_messages": limit,
+               "offset": int(pos), "follower_ok": True}
+        rpc = NULL_SPAN if self.spans is None else \
+            self.spans.span("client.rpc", self._trace_root.ctx)
+        if rpc.ctx is not None:
+            req["tctx"] = rpc.ctx.wire()
         try:
-            resp = self._transport.call(
-                addr,
-                {"type": "consume", "topic": topic, "partition": pid,
-                 "consumer": self.consumer_id, "max_messages": limit,
-                 "offset": int(pos), "follower_ok": True},
-                timeout=self._timeout,
-            )
+            resp = self._transport.call(addr, req, timeout=self._timeout)
         except RpcError:
+            rpc.end(error="rpc")
             return None
+        rpc.end()
         if not resp.get("ok") or not resp.get("follower"):
             return None  # not_settled_here / deposed: leader fallback
         msgs = list(resp["messages"])

@@ -19,6 +19,7 @@ would dedupe them away).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import zlib
 
@@ -28,6 +29,13 @@ import uuid
 from typing import Optional
 
 from ripplemq_tpu.client.metadata import MetadataError, MetadataManager
+from ripplemq_tpu.obs.spans import (
+    NULL_SPAN,
+    SpanRing,
+    TraceContext,
+    derive_trace_id,
+    sampled,
+)
 from ripplemq_tpu.metadata.models import RANGE_SPACE
 from ripplemq_tpu.client.selector import PartitionSelector, RoundRobinSelector
 from ripplemq_tpu.wire.retry import RetryPolicy, fatal_response_error
@@ -60,6 +68,7 @@ class ProducerClient:
         idempotence: bool = True,
         producer_name: Optional[str] = None,
         pid_refresh_s: float = 60.0,
+        trace_sample_n: int = 0,
     ) -> None:
         self._transport = transport if transport is not None else TcpClient()
         self._owns_transport = transport is None
@@ -87,6 +96,18 @@ class ProducerClient:
         self._pid_registered_t = 0.0
         self._seq_lock = make_lock("ProducerClient._seq_lock")
         self._seqs: dict[tuple[str, int], int] = {}
+        # Causal tracing (obs/spans.py): every trace_sample_n-th call
+        # (deterministic on the producer name + a per-call counter)
+        # opens a client.produce ROOT span whose context rides the
+        # request's optional `tctx` field; 0 disables — no ring, no
+        # counter tick, no clock read on the produce path. `spans` is
+        # public: the assembler reads the client's half of each trace
+        # here (admin.spans only covers server-side rings).
+        self._trace_sample_n = int(trace_sample_n)
+        self._trace_counter = itertools.count()
+        self.spans: Optional[SpanRing] = (
+            SpanRing(self._pid_name) if self._trace_sample_n > 0 else None
+        )
         self._selector = selector or RoundRobinSelector()
         self._timeout = rpc_timeout_s
         # One retry discipline for every operation (wire/retry.py):
@@ -142,6 +163,15 @@ class ProducerClient:
         at-least-once — exactly the retried-ack contract, never worse."""
         if not messages:
             raise ValueError("empty batch")
+        root = NULL_SPAN
+        if self.spans is not None:
+            tid = derive_trace_id(self._pid_name,
+                                  next(self._trace_counter))
+            if sampled(tid, self._trace_sample_n):
+                # Root context: parent span id 0 marks the trace root.
+                root = self.spans.span("client.produce",
+                                       TraceContext(tid, 0),
+                                       {"topic": topic})
         run = self._retry.begin()
         pin = partition
         khash = None if key is None else key_hash(key)
@@ -193,16 +223,27 @@ class ProducerClient:
                 gen = self._meta.generation(topic, pin)
                 if gen is not None:
                     req["pgen"] = gen
+            # One client.rpc span per transport ATTEMPT, and its id (not
+            # the root's) rides as tctx: the broker's rpc.recv then pairs
+            # with the wire round trip for the skew estimate, not with
+            # the whole retry loop.
+            rpc = NULL_SPAN if self.spans is None else \
+                self.spans.span("client.rpc", root.ctx)
+            if rpc.ctx is not None:
+                req["tctx"] = rpc.ctx.wire()
             try:
                 resp = self._transport.call(
                     addr, req, timeout=run.clip(self._timeout),
                 )
             except RpcError as e:
+                rpc.end(error=type(e).__name__)
                 run.note(str(e))
                 self._refresh_quietly()
                 continue
+            rpc.end()
             if resp.get("ok"):
                 self.last_partition = int(resp.get("routed_partition", pin))
+                root.end(n=n)  # duration == client-measured ack latency
                 return int(resp["base_offset"])
             err = str(resp.get("error", ""))
             run.note(err)

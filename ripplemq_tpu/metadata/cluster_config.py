@@ -169,6 +169,21 @@ class ClusterConfig:
     # (admin.trace) stays on either way: its per-round cost is a few
     # hundred ns and its value is being on when nobody planned to need it.
     obs: bool = True
+    # Causal tracing (obs/spans.py): every `trace_sample_n`-th trace-id
+    # residue of a client produce/consume is stamped with a trace
+    # context and every layer it touches records spans into per-process
+    # rings (admin.spans + obs/assemble.py join them into critical-path
+    # trees). 0 (default) disables sampling — no context rides the
+    # wire and every emit site short-circuits on `ctx is None` (the
+    # zero-overhead contract). Requires obs=True when enabled: the
+    # span rings share the metrics plane's monotonic clock domain so
+    # the engine's stage timestamps can be attributed verbatim.
+    trace_sample_n: int = 0
+    # Per-process span-ring capacity (records, not bytes). Sized like
+    # the flight recorder: large enough that one sampled produce's
+    # spans survive until the next admin.spans page, small enough to
+    # stay cache-resident.
+    span_ring_slots: int = 2048
     # Runtime lock witness (obs/lockwitness.py): when true, every
     # host-path lock this process creates is a recording wrapper that
     # captures per-thread acquisition orderings, cross-checkable
@@ -235,6 +250,15 @@ class ClusterConfig:
     slo_chain_depth_min: int = 1
     slo_chain_depth_max: int = 16
     slo_settle_window_min: int = 1
+    # Measured-prior rails (bench.py operating_curve): path to a JSON
+    # file of AIMD rail overrides ({"read_coalesce_min_s": ...,
+    # "read_coalesce_max_s": ..., "chain_depth_min": ...,
+    # "chain_depth_max": ..., "settle_window_min": ...} — any subset).
+    # Loaded once at controller construction, the overrides replace the
+    # static rails above, so the controller's FIRST tick is already
+    # clamped to the measured operating envelope instead of walking in
+    # from conservative defaults. "" (default) keeps the static rails.
+    slo_rails_file: str = ""
     # Shed threshold: settle-window occupancy at or above this fraction
     # of the EFFECTIVE window is shed evidence; the noisy signals
     # engage on 2 evidencing ticks within the last 5 (quorum
@@ -380,6 +404,18 @@ class ClusterConfig:
                 "slo_p99_ack_ms > 0 requires obs=True: the SLO "
                 "controller reads the live metrics registry"
             )
+        if self.trace_sample_n < 0:
+            raise ValueError("trace_sample_n must be >= 0 (0 disables)")
+        if self.trace_sample_n > 0 and not self.obs:
+            # Span rings record against the metrics plane's monotonic
+            # clock domain (the engine stage timestamps are attributed
+            # verbatim); with obs=False those stamps are never taken.
+            raise ValueError(
+                "trace_sample_n > 0 requires obs=True: span attribution "
+                "reuses the metrics plane's stage timestamps"
+            )
+        if self.span_ring_slots < 16:
+            raise ValueError("span_ring_slots must be >= 16")
         if self.slo_tick_s <= 0:
             raise ValueError("slo_tick_s must be > 0")
         if self.slo_recover_s <= 0:
@@ -585,6 +621,12 @@ def parse_cluster_config(raw: dict) -> ClusterConfig:
         extra["obs"] = bool(raw["obs"])
     if "lock_witness" in raw:
         extra["lock_witness"] = bool(raw["lock_witness"])
+    if "trace_sample_n" in raw:
+        extra["trace_sample_n"] = int(raw["trace_sample_n"])
+    if "span_ring_slots" in raw:
+        extra["span_ring_slots"] = int(raw["span_ring_slots"])
+    if "slo_rails_file" in raw:
+        extra["slo_rails_file"] = str(raw["slo_rails_file"])
     if "durability" in raw:
         extra["durability"] = str(raw["durability"])
     if "replication" in raw:

@@ -215,3 +215,52 @@ class Metrics:
                 k: h.summary() for k, h in sorted(histograms.items())
             },
         }
+
+
+def _prom_name(name: str) -> str:
+    """Registry name → Prometheus metric name: the `ripplemq_` prefix
+    plus the name with every non-[a-zA-Z0-9_] collapsed to `_` (the
+    registry's dotted names are not legal exposition identifiers)."""
+    return "ripplemq_" + "".join(
+        ch if ch.isalnum() or ch == "_" else "_" for ch in name
+    )
+
+
+def render_prometheus(metrics: Metrics) -> str:
+    """Prometheus text exposition of a live registry — the
+    admin.metrics_text surface (broker/server.py). GENERIC over the
+    registry by construction: every counter renders as `<name>_total`,
+    every gauge bare, every histogram as its cumulative log2 buckets
+    (`le` = each bin's inclusive upper bound 2^i - 1) plus `_sum` and
+    `_count` — so a metric added anywhere in the codebase shows up here
+    with no schema to update, and the exposition can never drift from
+    the registry (locked by tests/test_observability.py's exposition
+    test the way stats_schema locks admin.stats)."""
+    with metrics._lock:
+        counters = sorted(metrics._counters.items())
+        gauges = sorted(metrics._gauges.items())
+        histograms = sorted(metrics._histograms.items())
+    lines: list[str] = []
+    for name, c in counters:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn}_total counter")
+        lines.append(f"{pn}_total {c.n}")
+    for name, g in gauges:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {g.v}")
+    for name, h in histograms:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for i, b in enumerate(h.bins):
+            if b == 0:
+                continue  # sparse: 40 bins/metric would dominate bytes
+            cum += b
+            lines.append(
+                f'{pn}_bucket{{le="{(1 << i) - 1}"}} {cum}'
+            )
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{pn}_sum {h.total}")
+        lines.append(f"{pn}_count {h.count}")
+    return "\n".join(lines) + ("\n" if lines else "")

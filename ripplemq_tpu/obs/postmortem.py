@@ -50,6 +50,11 @@ def collect_postmortem(broker, trace_last: int = 256) -> dict:
         "engine": dp.postmortem() if dp is not None else None,
         "metrics": broker.metrics.snapshot(),
         "trace": broker.recorder.snapshot(last=trace_last),
+        # The causal-tracing ring (obs/spans.py), empty when sampling is
+        # off — a postmortem's sampled traces reassemble into critical-
+        # path trees with obs/assemble.py (chaos verdicts attach them).
+        "spans": (broker.spans.snapshot() if broker.spans is not None
+                  else []),
     }
     if dp is not None and dp.recorder is not broker.recorder:
         # An externally-injected plane keeps its own recorder; its round

@@ -54,6 +54,7 @@ from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Optional
 
 from ripplemq_tpu.obs.lockwitness import make_lock
+from ripplemq_tpu.obs.spans import SpanRing, ctx_from_wire
 from ripplemq_tpu.parallel.shmring import (
     RingClosedError,
     ShmRing,
@@ -186,6 +187,13 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
     seqs: dict[int, int] = {}
     served = stamped = 0
     parent = os.getppid()
+    # Worker-side span ring. The proc label carries the OS pid so two
+    # generations of the same worker index never collide in span-id
+    # space. Records for a sampled submit ride back to the dispatcher
+    # inside the existing response frame (no extra ring traffic);
+    # span_cursor tracks what has already been shipped.
+    spans = SpanRing(f"worker{worker_id}.{os.getpid()}")
+    span_cursor = -1
     try:
         while True:
             try:
@@ -201,6 +209,12 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
             if op in ("submit", "submit_raw"):
                 served += 1
                 out = {"id": m["id"], "ok": True}
+                # Sampled submits carry the dispatcher's worker.hop ctx;
+                # unsampled ones have no tctx and sp is the NULL_SPAN
+                # (no clock read, no allocation). A refused batch leaves
+                # its spans un-ended — absent, a partial trace.
+                sp = spans.span("worker.serve",
+                                ctx_from_wire(m.get("tctx")), {"op": op})
                 if op == "submit_raw":
                     # Raw dispatch: the broker peeked only the routing
                     # scalars off this client frame — THIS decode, on
@@ -220,6 +234,7 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
                         continue
                 else:
                     msgs = m["msgs"]
+                vs = spans.span("worker.validate", sp.ctx)
                 bad = None
                 if not msgs:
                     bad = "empty messages"
@@ -244,6 +259,8 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
                     out = {"id": m["id"], "ok": False, "why": bad}
                     resp.push(codec.encode(out))
                     continue
+                vs.end()
+                ss = spans.span("worker.stamp", sp.ctx)
                 if m.get("pid") is not None:
                     bpid, bseq = int(m["pid"]), int(m.get("seq", -1))
                 else:
@@ -255,14 +272,26 @@ def _host_worker_main(worker_id: int, req_name: str, resp_name: str,
                         stamped += len(msgs)
                     else:
                         bpid, bseq = 0, -1
+                ss.end()
+                ps = spans.span("worker.pack", sp.ctx)
                 chunks = []
                 for i in range(0, len(msgs), max_batch):
                     block, lens = _pack_rows(msgs[i : i + max_batch],
                                              slot_bytes)
                     chunks.append([lens, block])
+                ps.end()
                 out["pid"] = bpid
                 out["seq"] = bseq
                 out["chunks"] = chunks
+                sp.end(msgs=len(msgs))
+                if sp.ctx is not None:
+                    # Ship only the records this request added: the ring
+                    # is single-threaded here, so everything past the
+                    # cursor belongs to this (sampled) submit.
+                    recs = spans.snapshot(after=span_cursor)
+                    if recs:
+                        span_cursor = recs[-1]["seq"]
+                        out["spans"] = recs
                 resp.push(codec.encode(out))
             elif op == "read":
                 served += 1
@@ -568,7 +597,7 @@ class HostPlane:
     def __init__(self, n_workers: int, slot_bytes: int, payload_bytes: int,
                  max_batch: int, ring_bytes: int = 1 << 22,
                  mirror_budget: int = 4 << 20,
-                 recorder=None) -> None:
+                 recorder=None, spans=None) -> None:
         if n_workers < 1:
             raise ValueError("n_workers must be >= 1")
         self.n_workers = n_workers
@@ -578,6 +607,10 @@ class HostPlane:
         self.ring_bytes = ring_bytes
         self.mirror_budget = mirror_budget
         self.recorder = recorder
+        # Broker span ring (obs/spans.SpanRing) or None. Worker-side
+        # span records riding back in submit responses are ingested
+        # here so admin.spans serves one page covering both processes.
+        self.spans = spans
         self._lock = make_lock("HostPlane._lock")
         self._workers: list[Optional[_WorkerHandle]] = [None] * n_workers
         self._gens = [0] * n_workers
@@ -645,7 +678,7 @@ class HostPlane:
     # -- host-path ops --
 
     def submit(self, slot: int, messages: list, pid=None, seq=None,
-               timeout_s: float = 5.0) -> dict:
+               timeout_s: float = 5.0, tctx=None) -> dict:
         """Validate + stamp + pack one produce batch on the owning
         worker. Returns {"pid", "seq", "chunks": [(lens, packed), ...]}
         (chunks are max_batch-sized row blocks). Raises
@@ -671,9 +704,13 @@ class HostPlane:
         if pid is not None:
             op["pid"] = int(pid)
             op["seq"] = int(seq if seq is not None else -1)
+        if tctx is not None:
+            op["tctx"] = tctx  # wire form: [trace_id, parent_span_id]
         resp = self._handle(slot).request(op, timeout_s)
         if not resp.get("ok"):
             raise ValueError(str(resp.get("why", "submit refused")))
+        if self.spans is not None and resp.get("spans"):
+            self.spans.ingest(resp["spans"])
         return resp
 
     def submit_raw(self, slot: int, frame, n_msgs: int, pid=None, seq=None,

@@ -133,13 +133,19 @@ class SloController:
         self.recover_s = float(config.slo_recover_s)
         self.rc_min = float(config.slo_read_coalesce_min_s)
         self.rc_max = float(config.slo_read_coalesce_max_s)
+        self.cd_min = int(config.slo_chain_depth_min)
+        self.cd_max = int(config.slo_chain_depth_max)
+        self.sw_min = int(config.slo_settle_window_min)
+        # A measured prior (bench.py operating_curve writes one) narrows
+        # the static config rails so the AIMD law starts from this
+        # deployment's observed knee instead of the shipped defaults.
+        # Best-effort: a missing or malformed file keeps the config
+        # rails — a stale prior must never stop a broker from booting.
+        self._load_rails(str(getattr(config, "slo_rails_file", "") or ""))
         # Additive-increase step: 16 steps span the rail range, so a
         # recovered system re-earns its throughput posture over ~16
         # comfortable ticks instead of snapping back into the breach.
         self.rc_step = max(1e-4, (self.rc_max - self.rc_min) / 16.0)
-        self.cd_min = int(config.slo_chain_depth_min)
-        self.cd_max = int(config.slo_chain_depth_max)
-        self.sw_min = int(config.slo_settle_window_min)
         self.shed_occupancy = float(config.slo_shed_occupancy)
         self.admission = AdmissionController(
             dict(config.slo_quotas), clock=clock,
@@ -203,6 +209,43 @@ class SloController:
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="slo-controller",
         )
+
+    # ------------------------------------------------------------ rails
+
+    def _load_rails(self, path: str) -> None:
+        """Narrow the config rails from a measured prior (JSON written by
+        `python bench.py operating_curve`). Keys are optional; each one
+        present replaces the matching rail, then the pairs are re-ordered
+        so a prior measured under a different build can never produce an
+        inverted rail. Any failure keeps the config rails."""
+        if not path:
+            return
+        import json
+
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+            rails = prior.get("rails", prior)
+            if "read_coalesce_min_s" in rails:
+                self.rc_min = float(rails["read_coalesce_min_s"])
+            if "read_coalesce_max_s" in rails:
+                self.rc_max = float(rails["read_coalesce_max_s"])
+            if "chain_depth_min" in rails:
+                self.cd_min = max(1, int(rails["chain_depth_min"]))
+            if "chain_depth_max" in rails:
+                self.cd_max = max(1, int(rails["chain_depth_max"]))
+            if "settle_window_min" in rails:
+                self.sw_min = max(1, int(rails["settle_window_min"]))
+            if self.rc_min > self.rc_max:
+                self.rc_min, self.rc_max = self.rc_max, self.rc_min
+            if self.cd_min > self.cd_max:
+                self.cd_min, self.cd_max = self.cd_max, self.cd_min
+            log.info("slo rails loaded from %s: rc=[%g,%g] cd=[%d,%d] "
+                     "sw_min=%d", path, self.rc_min, self.rc_max,
+                     self.cd_min, self.cd_max, self.sw_min)
+        except Exception as e:
+            log.warning("slo_rails_file %s unusable (%s: %s) — keeping "
+                        "config rails", path, type(e).__name__, e)
 
     # ------------------------------------------------------------ lifecycle
 

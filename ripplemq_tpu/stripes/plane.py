@@ -65,6 +65,7 @@ from ripplemq_tpu.stripes.codec import (
     stripe_assignment,
 )
 from ripplemq_tpu.obs.lockwitness import make_condition, make_lock
+from ripplemq_tpu.obs.spans import ctx_from_wire
 from ripplemq_tpu.utils.logs import get_logger
 
 log = get_logger("stripes")
@@ -123,9 +124,11 @@ class _Group:
 
 class _StripeSender(threading.Thread):
     """Ordered stripe-frame stream to one standby. Entries are
-    (key, frames, idxs, fut-or-None): live entries ack through the
-    replicator's group tracker, catch-up entries resolve their own
-    future at RPC-ok."""
+    (key, frames, idxs, fut-or-None, tctxs-or-None): live entries ack
+    through the replicator's group tracker, catch-up entries resolve
+    their own future at RPC-ok; tctxs are the wire-form trace contexts
+    of the group's sampled produces, stamped onto the repl.stripes
+    request so holder-side apply spans join the trace."""
 
     def __init__(self, rep: "StripeReplicator", broker_id: int) -> None:
         super().__init__(daemon=True, name=f"stripe-sender-{broker_id}")
@@ -201,7 +204,8 @@ class _StripeSender(threading.Thread):
                 while self._queue and nbytes < _SEND_BATCH_BYTES:
                     nbytes += sum(len(f) for f in self._queue[0][1])
                     batch.append(self._queue.pop(0))
-            frames = [f for _, fs, _, _ in batch for f in fs]
+            frames = [f for entry in batch for f in entry[1]]
+            tctxs = [t for entry in batch for t in (entry[4] or ())]
 
             def fail_all(exc: Exception) -> None:
                 for entry in batch:
@@ -241,11 +245,13 @@ class _StripeSender(threading.Thread):
                     break
                 t0 = (self._rep._clock()
                       if self._rep._h_frame_us is not None else 0.0)
+                req = {"type": "repl.stripes", "epoch": epoch,
+                       "frames": frames}
+                if tctxs:
+                    req["tctx"] = tctxs
                 try:
                     resp = self._rep.client.call(
-                        self._rep.addr_of(self.broker_id),
-                        {"type": "repl.stripes", "epoch": epoch,
-                         "frames": frames},
+                        self._rep.addr_of(self.broker_id), req,
                         timeout=self._rep.rpc_timeout_s,
                     )
                 except Exception:
@@ -265,7 +271,8 @@ class _StripeSender(threading.Thread):
                         )
                         self._rep._c_bytes.inc(nbytes)
                         self._rep._c_frames.inc(len(frames))
-                    for key, fs, idxs, fut in batch:
+                    for entry in batch:
+                        key, idxs, fut = entry[0], entry[2], entry[3]
                         if fut is not None:
                             if not fut.done():
                                 fut.set_result(True)
@@ -347,6 +354,10 @@ class StripeReplicator:
             self._c_bytes = self._c_frames = None
             self._c_groups = self._c_retries = None
             self._clock = time.perf_counter
+        # Causal-tracing hook (obs/spans.py): the owning broker sets
+        # this to its SpanRing when trace sampling is configured;
+        # begin() then records stripe.send spans (see its docstring).
+        self.spans = None
         self._lock = make_lock("StripeReplicator._lock")
         self._senders: dict[int, _StripeSender] = {}
         self._joining: set[int] = set()
@@ -377,9 +388,12 @@ class StripeReplicator:
         self._floor = 0
         self._floor_pending: list[int] = []  # heapq of outstanding gsns
         self._floor_done: set[int] = set()
-        # Encoder queue: (records, fut) pairs drained as group commits.
         self._enc_cond = make_condition("StripeReplicator._enc_cond")
-        self._pending: list[tuple[list, Future]] = []
+        # Encoder inbox entries: (records, fut, tctxs) — tctxs the
+        # wire-form trace contexts of the round's sampled produces
+        # (None when untraced), carried through encode into the
+        # sender entries and onto the repl.stripes frames.
+        self._pending: list[tuple[list, Future, Optional[list]]] = []
         self._encoder = threading.Thread(
             target=self._encode_loop, daemon=True, name="stripe-encoder"
         )
@@ -440,9 +454,9 @@ class StripeReplicator:
             for f in g.futs:
                 if not f.done():
                     f.set_exception(exc)
-        for _, f in pending:
-            if not f.done():
-                f.set_exception(exc)
+        for entry in pending:
+            if not entry[1].done():
+                entry[1].set_exception(exc)
 
     # -- group ack tracking --
 
@@ -511,7 +525,7 @@ class StripeReplicator:
                         idx = next(i for i, b in g.targets.items()
                                    if b == bid)
                         self._sender(bid).enqueue(
-                            (None, [frames[idx]], [idx], None)
+                            (None, [frames[idx]], [idx], None, None)
                         )
                 except Exception:  # best-effort by design
                     log.debug("tombstone send for %s failed", g.key,
@@ -528,11 +542,16 @@ class StripeReplicator:
 
     # -- hot path (DataPlane settle pipeline) --
 
-    def begin(self, records: list) -> StripeTicket:
+    def begin(self, records: list,
+              tctxs: Optional[list] = None) -> StripeTicket:
         """Queue one round for encoding; returns the ticket wait()
         blocks on. Fences and the generalized empty/below-k refusal
         fire HERE (before anything is enqueued) from the current map;
-        the encoder and wait() re-check as membership moves."""
+        the encoder and wait() re-check as membership moves. `tctxs`
+        carries the wire-form trace contexts of the round's sampled
+        produces: stamped onto the stripe frames and recorded as
+        sender-side stripe.send spans that end when the round's stripe
+        quorum (or terminal failure) resolves."""
         if not self.active():
             raise FencedError("controller deposed (local metadata)")
         held = self.stripe_map_fn()
@@ -570,10 +589,21 @@ class StripeReplicator:
                 f"only {len(coverage)} of {RS_K + RS_M} stripes held by "
                 f"live members (need {RS_K}): refusing to settle"
             )
+        if tctxs and self.spans is not None:
+            # One stripe.send span per sampled produce, covering encode
+            # queue + fan-out + the k-quorum wait (the sender-side half
+            # of the striped replication edge; holders record
+            # stripe.apply on their side).
+            for raw in tctxs:
+                ctx = ctx_from_wire(raw)
+                if ctx is None:
+                    continue
+                sp = self.spans.span("stripe.send", ctx)
+                fut.add_done_callback(lambda _f, s=sp: s.end())
         with self._enc_cond:
             if self._stopped:
                 raise ReplicationError("replicator stopped")
-            self._pending.append((records, fut))
+            self._pending.append((records, fut, tctxs))
             self._enc_cond.notify()
         return StripeTicket(fut, time.monotonic())
 
@@ -678,7 +708,7 @@ class StripeReplicator:
                 while (self._pending
                        and len(group) < _GROUP_COMMIT_ROUNDS
                        and nbytes < _GROUP_COMMIT_BYTES):
-                    recs, _ = self._pending[0]
+                    recs = self._pending[0][0]
                     nbytes += sum(len(r[3]) for r in recs)
                     group.append(self._pending.pop(0))
             try:
@@ -686,14 +716,16 @@ class StripeReplicator:
             except Exception as e:  # encoder must never die
                 log.warning("stripe encode failed: %s: %s",
                             type(e).__name__, e)
-                for _, f in group:
+                for entry in group:
+                    f = entry[1]
                     if not f.done():
                         f.set_exception(ReplicationError(
                             f"stripe encode failed: {e}"
                         ))
 
-    def _encode_and_send(self, group: list[tuple[list, Future]]) -> None:
-        futs = [f for _, f in group]
+    def _encode_and_send(self, group: list[tuple]) -> None:
+        futs = [e[1] for e in group]
+        tctxs = [t for e in group for t in (e[2] or ())] or None
         if not self.active():
             exc = FencedError("controller deposed (local metadata)")
             for f in futs:
@@ -722,7 +754,7 @@ class StripeReplicator:
                 if not f.done():
                     f.set_exception(exc)
             return
-        records = [r for recs, _ in group for r in recs]
+        records = [r for e in group for r in e[0]]
         with self._lock:
             gsn = self._gsn
             self._gsn += 1
@@ -758,7 +790,7 @@ class StripeReplicator:
                     self._fut_key[f] = key
         for bid, idxs in by_member.items():
             self._sender(bid).enqueue(
-                (key, [frames[i] for i in idxs], idxs, None)
+                (key, [frames[i] for i in idxs], idxs, None, tctxs)
             )
         # Joining brokers get the round's DATA stripes on their
         # buffered stream (the gap-free join invariant: any record the
@@ -770,7 +802,7 @@ class StripeReplicator:
                 continue
             self._sender(bid).enqueue(
                 (None, [frames[i] for i in range(RS_K)],
-                 list(range(RS_K)), None)
+                 list(range(RS_K)), None, tctxs)
             )
         if not held:
             # No member gates the settle (first join in flight): the
@@ -831,7 +863,7 @@ class StripeReplicator:
                               settled_floor=floor, **self.encode_kw)
         fut: Future = Future()
         s.enqueue_catchup(((epoch, gsn), [frames[i] for i in idxs],
-                           idxs, fut))
+                           idxs, fut, None))
         return fut
 
     def finish_join(self, bid: int) -> None:

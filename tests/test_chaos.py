@@ -61,7 +61,8 @@ def test_fixed_seed_chaos_smoke(seed):
     # postmortem bundle per reachable broker — the exact surface a
     # violating run attaches automatically — and the merged
     # fault-vs-lifecycle timeline interleaves nemesis fault ops with
-    # broker flight-recorder events in wall-clock order.
+    # broker flight-recorder events by SKEW-CORRECTED time (per-source
+    # seq order preserved — raw wall-clock sorting is gone).
     assert verdict["postmortems"], "no postmortem bundles collected"
     for bid, pm in verdict["postmortems"].items():
         assert pm["ok"] and pm["broker"] == int(bid)
@@ -73,7 +74,12 @@ def test_fixed_seed_chaos_smoke(seed):
     tl = verdict["timeline"]
     srcs = {e["src"] for e in tl}
     assert "nemesis" in srcs and any(s.startswith("broker") for s in srcs)
-    assert [e["t"] for e in tl] == sorted(e["t"] for e in tl)
+    assert [e["tc"] for e in tl] == sorted(e["tc"] for e in tl)
+    # Per-source order is never disturbed by the merge: each source's
+    # events appear in their original (causal seq) order.
+    for src in srcs:
+        ts = [e["t"] for e in tl if e["src"] == src]
+        assert ts == sorted(ts), src
     # Convergence gated on the documented contention flake class (the
     # gate is semantic — safety clean AND the drain served the full
     # log — not a wider timeout; see helpers.assert_chaos_liveness).
@@ -354,3 +360,49 @@ def test_checker_passes_clean_history():
          "status": "ok", "offset": 4, "next_offset": 4, "payloads": []},
     ]
     assert check_history(ops, {("t", 0): ["a"], ("t", 1): ["b"]}) == []
+
+
+# --------------------------------------------------------- timeline merge
+
+
+def test_merge_timeline_corrects_forced_skew():
+    """DIRECTED forced-skew case: a broker whose wall clock runs 10 s
+    ahead must not have its events sorted into the future. The skew
+    estimate (from the admin.trace RPC's NTP-style midpoint) pulls the
+    stream back into the nemesis frame; a raw wall-clock sort — what
+    the merge replaced — gets the interleaving wrong."""
+    from ripplemq_tpu.chaos.harness import merge_timeline
+
+    nem = [{"src": "nemesis", "t": 100.00, "type": "crash"},
+           {"src": "nemesis", "t": 100.30, "type": "heal"}]
+    brk = [{"src": "broker0", "t": 110.10, "type": "dispatch"},
+           {"src": "broker0", "t": 110.20, "type": "commit"}]
+    merged = merge_timeline({"nemesis": nem, "broker0": brk},
+                            skews={"broker0": 10.0})
+    order = [(e["src"], e["type"]) for e in merged]
+    assert order == [("nemesis", "crash"), ("broker0", "dispatch"),
+                     ("broker0", "commit"), ("nemesis", "heal")]
+    # Corrected stamps are monotone and carried on every event.
+    assert [e["tc"] for e in merged] == sorted(e["tc"] for e in merged)
+    assert merged[1]["tc"] == pytest.approx(100.10)
+    # The raw wall-clock sort this replaces interleaves wrongly: both
+    # broker events land after the heal.
+    raw = [(e["src"], e["type"])
+           for e in sorted(nem + brk, key=lambda e: e["t"])]
+    assert raw != order and raw[-2:] == [("broker0", "dispatch"),
+                                         ("broker0", "commit")]
+
+
+def test_merge_timeline_never_reorders_within_a_source():
+    """Per-source seq order is the causal truth; the skew estimate is
+    not. Even a stream whose raw stamps are non-monotone (clock step
+    mid-run) keeps its original order, and absent skews default to 0."""
+    from ripplemq_tpu.chaos.harness import merge_timeline
+
+    stepped = [{"src": "x", "t": 5.0, "type": "a"},
+               {"src": "x", "t": 4.0, "type": "b"},
+               {"src": "x", "t": 6.0, "type": "c"}]
+    merged = merge_timeline({"x": stepped})
+    assert [e["type"] for e in merged] == ["a", "b", "c"]
+    assert [e["tc"] for e in merged] == [5.0, 4.0, 6.0]
+    assert merge_timeline({}) == []

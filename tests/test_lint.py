@@ -355,6 +355,18 @@ def test_trace_emit_extraction():
     assert [(n) for _, n in emits] == ["rogue_event"]
 
 
+def test_span_emit_extraction():
+    src = _parse("""
+        class X:
+            def go(self, ctx):
+                sp = self.spans.span("rpc.recv", ctx)
+                self.spans.span_at("stripe.reconstruct", ctx, 0.0, 1.0)
+                self.spans.span(kind, ctx)  # non-literal: out of scope
+    """)
+    emits = trace_vocab.emit_sites(src, ("span", "span_at"))
+    assert [n for _, n in emits] == ["rpc.recv", "stripe.reconstruct"]
+
+
 def test_trace_vocab_fixture_caught(tmp_path):
     """The seeded regression (PR 9's actual drift): an event emitted
     with no vocabulary entry — and, symmetrically, a vocabulary entry
@@ -363,17 +375,24 @@ def test_trace_vocab_fixture_caught(tmp_path):
     (tmp_path / "ripplemq_tpu/broker").mkdir(parents=True)
     (tmp_path / trace_vocab.TRACE_PATH).write_text(
         'EVENT_TYPES = frozenset({"dispatch", "renamed_away"})\n')
+    (tmp_path / trace_vocab.SPANS_PATH).write_text(
+        'SPAN_KINDS = frozenset({"rpc.recv", "kind_renamed_away"})\n')
     (tmp_path / "ripplemq_tpu/broker/server.py").write_text(
         textwrap.dedent("""
             class S:
-                def go(self):
+                def go(self, ctx):
                     self.recorder.record("dispatch", n=1)
                     self.recorder.record("rogue_event", n=2)
+                    self.spans.span("rpc.recv", ctx)
+                    self.spans.span_at("rogue.kind", ctx, 0.0, 1.0)
         """))
     (tmp_path / "README.md").write_text(
-        f"{trace_vocab.README_HEADING}\n\n`dispatch` `renamed_away`\n")
+        f"{trace_vocab.README_HEADING}\n\n`dispatch` `renamed_away`\n\n"
+        f"{trace_vocab.SPAN_README_HEADING}\n\n"
+        f"`rpc.recv` `kind_renamed_away`\n")
     keys = {f.key for f in trace_vocab.check(Repo(tmp_path))}
-    assert keys == {"undocumented::rogue_event", "dead::renamed_away"}
+    assert keys == {"undocumented::rogue_event", "dead::renamed_away",
+                    "undocumented::rogue.kind", "dead::kind_renamed_away"}
 
 
 def test_trace_vocab_parses_live_set():
@@ -382,6 +401,12 @@ def test_trace_vocab_parses_live_set():
     # The PR 9 drift this rule was built from: stripe_rebuild emitted
     # but undocumented; it is now both in the vocabulary and README.
     assert "stripe_rebuild" in vocab and "dispatch" in vocab
+    kinds = trace_vocab.vocabulary(
+        repo.tree(trace_vocab.SPANS_PATH), trace_vocab.SPAN_VOCAB_NAME)
+    # The span-kind vocabulary is the second closed set under this
+    # rule; the cross-process skew pairs must both be present.
+    assert {"client.rpc", "rpc.recv", "worker.hop", "worker.serve",
+            "repl.send", "repl.apply"} <= kinds
 
 
 # ---- markers: the unmarked-soak class --------------------------------

@@ -654,3 +654,82 @@ def test_consume_twin_stats_surface():
     assert st["consume_p99_ms"] is not None
     assert st["consume_p99_ms"] <= 10.0
     assert st["consume_meeting_slo"] is True
+
+
+# ------------------------------------------------------------ rails prior
+
+
+def test_rails_prior_file_clamps_first_tick(tmp_path):
+    """A measured prior (bench.py operating_curve format) narrows the
+    config rails at construction, and the very first evidencing breach
+    tick clamps against the PRIOR's floor, not the config's: halving
+    the plane's 0.004 s coalesce would land at 0.002 — inside the
+    config rails — but the prior floor of 0.003 catches it."""
+    import json
+
+    rails = tmp_path / "rails.json"
+    rails.write_text(json.dumps({
+        "method": "bench.py operating_curve",
+        "rails": {"read_coalesce_min_s": 0.003,
+                  "read_coalesce_max_s": 0.006,
+                  "chain_depth_min": 2,
+                  "chain_depth_max": 8,
+                  "settle_window_min": 3},
+    }))
+    cfg = slo_config(slo_rails_file=str(rails))
+    plane = FakePlane()
+    ctl, metrics, recorder, clock, _ = make_controller(cfg, plane=plane)
+    assert ctl.rc_min == pytest.approx(0.003)
+    assert ctl.rc_max == pytest.approx(0.006)
+    assert (ctl.cd_min, ctl.cd_max, ctl.sw_min) == (2, 8, 3)
+    # Tick 1 only snapshots the histogram; tick 2 is the first MEASURED
+    # window — deep in breach, so the MD law fires immediately.
+    feed(metrics, 400.0)
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    feed(metrics, 400.0)
+    clock.advance(ctl.tick_s)
+    ctl.tick()
+    assert plane.read_coalesce_s == pytest.approx(0.003)  # not 0.002
+    # Breach forever: every knob floors at the PRIOR's rails, which sit
+    # strictly inside the config rails (0.001 / 1 / 2).
+    for _ in range(10):
+        feed(metrics, 400.0)
+        clock.advance(ctl.tick_s)
+        ctl.tick()
+    ks = plane.knob_state()
+    assert ks["read_coalesce_s"] == pytest.approx(0.003)
+    assert ks["chain_depth"] == 2
+    assert ks["settle_window"] == 3
+
+
+def test_rails_prior_bad_file_keeps_config_rails(tmp_path):
+    """A malformed or missing prior must never stop a broker from
+    booting: the config rails stand."""
+    bad = tmp_path / "rails.json"
+    bad.write_text("{not json")
+    cfg = slo_config(slo_rails_file=str(bad))
+    ctl, _, _, _, _ = make_controller(cfg)
+    assert ctl.rc_min == pytest.approx(cfg.slo_read_coalesce_min_s)
+    assert ctl.rc_max == pytest.approx(cfg.slo_read_coalesce_max_s)
+    assert ctl.cd_min == cfg.slo_chain_depth_min
+    missing = slo_config(slo_rails_file=str(tmp_path / "nope.json"))
+    ctl2, _, _, _, _ = make_controller(missing)
+    assert ctl2.sw_min == missing.slo_settle_window_min
+
+
+def test_rails_prior_inverted_pair_reordered(tmp_path):
+    """A prior measured under a different build can carry an inverted
+    pair; the loader re-orders instead of handing the AIMD law an
+    empty range."""
+    import json
+
+    rails = tmp_path / "rails.json"
+    rails.write_text(json.dumps({"rails": {
+        "read_coalesce_min_s": 0.006, "read_coalesce_max_s": 0.002,
+        "chain_depth_min": 12, "chain_depth_max": 4}}))
+    ctl, _, _, _, _ = make_controller(
+        slo_config(slo_rails_file=str(rails)))
+    assert ctl.rc_min == pytest.approx(0.002)
+    assert ctl.rc_max == pytest.approx(0.006)
+    assert (ctl.cd_min, ctl.cd_max) == (4, 12)
